@@ -1,0 +1,21 @@
+"""progen-tpu: a TPU-native framework for autoregressive protein language models.
+
+A ground-up reimplementation of the capabilities of lucidrains/progen
+(reference: /root/reference) designed for TPU hardware: batch-first models,
+jit/pjit + GSPMD sharding over a (data, seq, model) mesh, Pallas kernels for
+the windowed local attention, sharded checkpoints, and a multi-host sharded
+data pipeline.
+
+The reference model (progen_transformer/progen.py) is a decoder-only LM over
+byte-tokenized protein sequences: token embedding -> depth x (windowed local
+attention + feed-forward) -> LayerNorm + logits head, with RoPE applied to
+q/k/v, token-shift, GLU feed-forwards, and gMLP (spatial-gating) feed-forwards
+on the trailing `global_mlp_depth` layers.
+"""
+
+__version__ = "0.1.0"
+
+from progen_tpu.config import ProGenConfig
+from progen_tpu.models.progen import ProGen
+
+__all__ = ["ProGen", "ProGenConfig", "__version__"]

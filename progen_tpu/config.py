@@ -1,0 +1,120 @@
+"""Model/run configuration.
+
+Field names intentionally match the reference TOML schema
+(/root/reference/configs/model/default.toml and the `ProGenBase.__init__`
+signature at /root/reference/progen_transformer/progen.py:188-203) so that
+reference configs load unmodified. TPU-specific knobs are additive.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping
+
+import jax.numpy as jnp
+
+_DTYPES = {
+    "float32": jnp.float32,
+    "bfloat16": jnp.bfloat16,
+    "float16": jnp.float16,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ProGenConfig:
+    # --- reference-parity hyperparameters (progen.py:188-203 defaults) ---
+    num_tokens: int = 256
+    dim: int = 512
+    seq_len: int = 1024
+    depth: int = 6
+    window_size: int = 256
+    global_mlp_depth: int = 2
+    heads: int = 8
+    dim_head: int = 64
+    ff_mult: int = 4
+    ff_glu: bool = True
+    shift_tokens: bool = True
+    # RoPE is applied to q, k AND v in the reference (progen.py:87). Keep that
+    # behavior behind a flag so it is a conscious choice, not an accident.
+    rotate_value: bool = True
+    sgu_init_eps: float = 1e-3
+    layer_norm_epsilon: float = 1e-5  # hk.LayerNorm default
+
+    # --- TPU-native knobs (additive; no reference equivalent) ---
+    # Mixed precision: params live in float32, compute in `dtype`, logits are
+    # returned in float32 (the jmp policy of progen.py:235, with bf16 instead
+    # of f16 because bf16 is native to the MXU).
+    dtype: str = "bfloat16"
+    param_dtype: str = "float32"
+    # Use the Pallas local-attention kernel instead of the XLA reference path.
+    use_pallas_attn: bool = False
+    # Rematerialize each block's activations during backprop.
+    remat: bool = False
+    # Shard activations' sequence axis over the mesh 'seq' axis (sequence
+    # parallelism via halo exchange); requires seq_len % (seq_shards *
+    # window_size) == 0.
+    sequence_parallel: bool = False
+
+    @property
+    def compute_dtype(self):
+        return _DTYPES[self.dtype]
+
+    @property
+    def params_dtype(self):
+        return _DTYPES[self.param_dtype]
+
+    @property
+    def inner_dim(self) -> int:
+        return self.heads * self.dim_head
+
+    def __post_init__(self):
+        if self.seq_len % self.window_size != 0:
+            raise ValueError(
+                f"seq_len ({self.seq_len}) must be divisible by window_size "
+                f"({self.window_size})"
+            )
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "ProGenConfig":
+        """Build from a dict (e.g. parsed TOML), ignoring unknown keys that the
+        reference accepted but never used (attn_dim, clamp_gate — see
+        progen.py:201-202, dead parameters)."""
+        names = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in names})
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def num_params(self) -> int:
+        """Closed-form parameter count (for MFU math without materializing)."""
+        d, h = self.dim, self.ff_mult * self.dim
+        n = 0
+        n += self.num_tokens * d  # embed
+        for i in range(self.depth):
+            use_gmlp = (self.depth - i) <= self.global_mlp_depth
+            use_glu = (not use_gmlp) and self.ff_glu
+            # attention: ln scale + qkv + out proj (+bias)
+            n += d + d * 3 * self.inner_dim + self.inner_dim * d + d
+            hidden = h * (2 if use_glu else 1)
+            if use_gmlp:
+                hidden = h
+            # ff: ln scale + proj_in(+bias)
+            n += d + d * hidden + hidden
+            if use_gmlp:
+                half = hidden // 2
+                # sgu: gate ln scale + spatial weights + biases + proj_out
+                n += half + self.seq_len * self.seq_len + self.seq_len
+                n += half * half + half
+                n += half * d + d  # ff proj_out from half
+            else:
+                inner = hidden // 2 if use_glu else hidden
+                n += inner * d + d  # ff proj_out
+        n += d + d * self.num_tokens + self.num_tokens  # final ln + head
+        return n
+
+
+def load_toml_config(path: str) -> dict:
+    import tomllib
+
+    with open(path, "rb") as f:
+        return tomllib.load(f)

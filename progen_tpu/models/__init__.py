@@ -1,0 +1,3 @@
+from progen_tpu.models.progen import ProGen
+
+__all__ = ["ProGen"]

@@ -1,0 +1,210 @@
+"""ProGen building blocks as flax.linen modules, batch-first, TPU-sharded.
+
+Behavioral parity targets (cited into /root/reference/progen_transformer/):
+  * LocalAttentionBlock  <- progen.py:50-103  (pre-LN, token-shift, bias-free
+    fused QKV, RoPE on q/k/v, windowed attention, output projection)
+  * FeedForwardBlock     <- progen.py:105-149 (pre-LN, token-shift, GLU or
+    GELU, optional spatial gating, output projection)
+  * SpatialGatingUnit    <- progen.py:151-185 (gate LayerNorm, learned causal
+    (n, n) spatial mix with uniform ±eps/n init and ones bias)
+
+Every weight carries flax logical-axis metadata so the whole model shards
+through one rule table (progen_tpu/parallel/partition.py). LayerNorms are
+scale-only (create_offset=False in the reference, progen.py:22).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from progen_tpu.config import ProGenConfig
+from progen_tpu.ops.attention import local_attention
+from progen_tpu.ops.rotary import apply_rotary_pos_emb
+from progen_tpu.ops.sgu import causal_sgu_mix
+from progen_tpu.ops.shift import shift_tokens
+
+
+def _dense_init():
+    # Matches the scale of hk.Linear's default TruncatedNormal(1/sqrt(fan_in)).
+    return nn.initializers.lecun_normal()
+
+
+class ScaleNorm(nn.Module):
+    """Scale-only LayerNorm (hk.LayerNorm(create_scale=True, create_offset=False))."""
+
+    epsilon: float = 1e-5
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.LayerNorm(
+            epsilon=self.epsilon,
+            use_bias=False,
+            use_scale=True,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            scale_init=nn.with_logical_partitioning(
+                nn.initializers.ones, ("embed",)
+            ),
+            name="norm",
+        )(x)
+
+
+class LocalAttentionBlock(nn.Module):
+    config: ProGenConfig
+
+    @nn.compact
+    def __call__(self, x, sin, cos):
+        c = self.config
+        b, n, _ = x.shape
+        h, dh, w = c.heads, c.dim_head, c.window_size
+
+        x = ScaleNorm(c.layer_norm_epsilon, c.compute_dtype, c.params_dtype)(x)
+        if c.shift_tokens:
+            x = shift_tokens(x)
+
+        qkv = nn.Dense(
+            3 * c.inner_dim,
+            use_bias=False,
+            dtype=c.compute_dtype,
+            param_dtype=c.params_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                _dense_init(), ("embed", "qkv")
+            ),
+            name="to_qkv",
+        )(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def split_heads(t):  # (b, n, h*dh) -> (b, h, n, dh); feature = (h, dh)
+            return t.reshape(b, n, h, dh).transpose(0, 2, 1, 3)
+
+        q, k, v = map(split_heads, (q, k, v))
+
+        q = apply_rotary_pos_emb(q, sin, cos)
+        k = apply_rotary_pos_emb(k, sin, cos)
+        if c.rotate_value:  # reference rotates v too (progen.py:87)
+            v = apply_rotary_pos_emb(v, sin, cos)
+
+        if c.use_pallas_attn:
+            from progen_tpu.ops.pallas_attention import pallas_local_attention
+
+            out = pallas_local_attention(q, k, v, window_size=w)
+        else:
+            out = local_attention(q, k, v, window_size=w)
+
+        out = out.transpose(0, 2, 1, 3).reshape(b, n, c.inner_dim)
+        out = nn.with_logical_constraint(out, ("batch", "seq_act", None))
+        return nn.Dense(
+            c.dim,
+            dtype=c.compute_dtype,
+            param_dtype=c.params_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                _dense_init(), ("qkv", "embed")
+            ),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros, ("embed",)
+            ),
+            name="to_out",
+        )(out)
+
+
+class SpatialGatingUnit(nn.Module):
+    config: ProGenConfig
+    dim_out: int
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.config
+        n = c.seq_len
+        assert x.shape[-2] == n, (
+            f"SGU is bound to seq_len={n} at init, got sequence {x.shape[-2]}"
+        )
+        x, gate = jnp.split(x, 2, axis=-1)
+
+        gate = ScaleNorm(c.layer_norm_epsilon, c.compute_dtype, c.params_dtype)(gate)
+
+        init_scale = c.sgu_init_eps / n
+
+        def symmetric_uniform(key, shape, dtype):
+            return jax.random.uniform(
+                key, shape, dtype, minval=-init_scale, maxval=init_scale
+            )
+
+        weights = self.param(
+            "spatial_weights",
+            nn.with_logical_partitioning(
+                symmetric_uniform, ("sgu_seq_out", "sgu_seq_in")
+            ),
+            (n, n),
+            c.params_dtype,
+        )
+        biases = self.param(
+            "spatial_biases",
+            nn.with_logical_partitioning(nn.initializers.ones, ("sgu_seq_out", None)),
+            (n, 1),
+            c.params_dtype,
+        )
+
+        gate = causal_sgu_mix(gate, weights, biases).astype(x.dtype)
+        x = x * gate
+        return nn.Dense(
+            self.dim_out,
+            dtype=c.compute_dtype,
+            param_dtype=c.params_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                _dense_init(), ("sgu_hidden", "mlp")
+            ),
+            bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("mlp",)),
+            name="proj_out",
+        )(x)
+
+
+class FeedForwardBlock(nn.Module):
+    config: ProGenConfig
+    glu: bool = False
+    spatial_gate: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.config
+        assert not (self.glu and self.spatial_gate), (
+            "glu and sgu cannot be turned on at the same time"
+        )
+        hidden = c.dim * c.ff_mult * (2 if self.glu else 1)
+
+        x = ScaleNorm(c.layer_norm_epsilon, c.compute_dtype, c.params_dtype)(x)
+        if c.shift_tokens:
+            x = shift_tokens(x)
+
+        x = nn.Dense(
+            hidden,
+            dtype=c.compute_dtype,
+            param_dtype=c.params_dtype,
+            kernel_init=nn.with_logical_partitioning(_dense_init(), ("embed", "mlp")),
+            bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("mlp",)),
+            name="proj_in",
+        )(x)
+
+        if self.glu:
+            x, gate = jnp.split(x, 2, axis=-1)
+            x = x * jax.nn.gelu(gate)
+        else:
+            x = jax.nn.gelu(x)
+
+        if self.spatial_gate:
+            x = SpatialGatingUnit(c, dim_out=hidden // 2, name="sgu")(x)
+
+        x = nn.with_logical_constraint(x, ("batch", "seq_act", "mlp_act"))
+        return nn.Dense(
+            c.dim,
+            dtype=c.compute_dtype,
+            param_dtype=c.params_dtype,
+            kernel_init=nn.with_logical_partitioning(_dense_init(), ("mlp", "embed")),
+            bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
+            name="proj_out",
+        )(x)

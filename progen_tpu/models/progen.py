@@ -1,0 +1,86 @@
+"""The ProGen model: a decoder-only protein LM, batch-first, TPU-sharded.
+
+Architecture parity with /root/reference/progen_transformer/progen.py:187-233:
+token embed -> depth x (LocalAttention + FeedForward) with residual adds,
+the last `global_mlp_depth` layers using gMLP (spatial-gate) feed-forwards
+with GLU disabled (progen.py:211-212), then scale-only LayerNorm + linear
+logits head (no weight tying).
+
+TPU-first deltas:
+  * real leading batch axis (the reference is single-sequence + external vmap,
+    progen.py:224-227) so XLA sees one large MXU-friendly program;
+  * mixed precision bf16 compute / f32 params / f32 logits (the jmp policy of
+    progen.py:235 with bf16, which is native to the MXU);
+  * flax logical-axis metadata on every weight, consumed by
+    progen_tpu/parallel/partition.py to lay the model over a device mesh;
+  * optional per-block rematerialization (config.remat) to trade FLOPs for
+    HBM during backprop.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from progen_tpu.config import ProGenConfig
+from progen_tpu.models.layers import (
+    FeedForwardBlock,
+    LocalAttentionBlock,
+    ScaleNorm,
+)
+from progen_tpu.ops.rotary import fixed_pos_embedding
+
+
+class ProGen(nn.Module):
+    config: ProGenConfig
+
+    @nn.compact
+    def __call__(self, tokens: jnp.ndarray) -> jnp.ndarray:
+        """tokens: (batch, seq_len) integer array. Returns float32 logits of
+        shape (batch, seq_len, num_tokens)."""
+        c = self.config
+        n = tokens.shape[-1]
+
+        x = nn.Embed(
+            c.num_tokens,
+            c.dim,
+            dtype=c.compute_dtype,
+            param_dtype=c.params_dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.truncated_normal(stddev=0.02), ("vocab", "embed")
+            ),
+            name="embed",
+        )(tokens)
+        x = nn.with_logical_constraint(x, ("batch", "seq_act", "embed_act"))
+
+        # RoPE tables are tiny; build in f32 once per trace (progen.py:227).
+        sin, cos = fixed_pos_embedding(n, c.dim_head)
+
+        attn_cls, ff_cls = LocalAttentionBlock, FeedForwardBlock
+        if c.remat:
+            attn_cls = nn.remat(LocalAttentionBlock)
+            ff_cls = nn.remat(FeedForwardBlock)
+
+        for i in range(c.depth):
+            use_gmlp = (c.depth - i) <= c.global_mlp_depth
+            use_glu = (not use_gmlp) and c.ff_glu
+            x = x + attn_cls(c, name=f"attn{i}")(x, sin, cos)
+            x = x + ff_cls(
+                c, glu=use_glu, spatial_gate=use_gmlp, name=f"ff{i}"
+            )(x)
+            x = nn.with_logical_constraint(x, ("batch", "seq_act", "embed_act"))
+
+        x = ScaleNorm(c.layer_norm_epsilon, c.compute_dtype, c.params_dtype)(x)
+        logits = nn.Dense(
+            c.num_tokens,
+            dtype=c.compute_dtype,
+            param_dtype=c.params_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("embed", "vocab")
+            ),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros, ("vocab",)
+            ),
+            name="to_logits",
+        )(x)
+        return logits.astype(jnp.float32)

@@ -1,0 +1,18 @@
+from progen_tpu.ops.rotary import (
+    fixed_pos_embedding,
+    rotate_every_two,
+    apply_rotary_pos_emb,
+)
+from progen_tpu.ops.shift import shift_tokens
+from progen_tpu.ops.attention import local_attention, ATTN_MASK_VALUE
+from progen_tpu.ops.sgu import causal_sgu_mix
+
+__all__ = [
+    "fixed_pos_embedding",
+    "rotate_every_two",
+    "apply_rotary_pos_emb",
+    "shift_tokens",
+    "local_attention",
+    "causal_sgu_mix",
+    "ATTN_MASK_VALUE",
+]

@@ -1,0 +1,101 @@
+"""Windowed causal local attention — XLA reference path.
+
+Numerics follow /root/reference/progen_transformer/progen.py:79-103: the
+sequence is cut into n/w windows; each query window attends to its own window
+plus the previous one (the previous window of window 0 is zeros); the score
+mask is tril(ones((w, 2w)), w); masked positions get -1e10; softmax is
+stabilized by subtracting a stop-gradient running max.
+
+Differences from the reference are deliberate TPU choices, not omissions:
+  * batch-first (b, h, n, d) with a static window reshape — one big batched
+    einsum per step so XLA tiles it onto the MXU;
+  * scores and softmax accumulate in float32 regardless of compute dtype
+    (bf16-safe), output is cast back to the input dtype;
+  * the mask is built once at trace time as a constant.
+
+A Pallas flash-style kernel for the same math lives in
+progen_tpu/ops/pallas_attention.py; this module is the golden reference the
+kernel is validated against.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+ATTN_MASK_VALUE = -1e10
+
+
+def _window_mask(window_size: int) -> jnp.ndarray:
+    """Boolean (w, 2w) mask: query i in a window may attend to concatenated
+    [previous window | current window] keys j with j <= i + w."""
+    i = jnp.arange(window_size)[:, None]
+    j = jnp.arange(2 * window_size)[None, :]
+    return j <= i + window_size
+
+
+def local_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    window_size: int,
+    scale: float | None = None,
+    mask_value: float = ATTN_MASK_VALUE,
+) -> jnp.ndarray:
+    """q, k, v: (batch, heads, n, dim_head) with n % window_size == 0.
+
+    Returns (batch, heads, n, dim_head) in q.dtype.
+    """
+    b, h, n, d = q.shape
+    w = window_size
+    if n % w != 0:
+        raise ValueError(f"sequence length {n} not divisible by window {w}")
+    nw = n // w
+    if scale is None:
+        scale = d ** -0.5
+
+    # (b, h, nw, w, d)
+    qw = q.reshape(b, h, nw, w, d)
+    kw = k.reshape(b, h, nw, w, d)
+    vw = v.reshape(b, h, nw, w, d)
+
+    # Each window's keys/values = [previous window | current window]; the
+    # previous window of window 0 is zeros (masked out anyway).
+    def with_prev(t):
+        prev = jnp.pad(t[:, :, :-1], ((0, 0), (0, 0), (1, 0), (0, 0), (0, 0)))
+        return jnp.concatenate((prev, t), axis=3)  # (b, h, nw, 2w, d)
+
+    kw2, vw2 = with_prev(kw), with_prev(vw)
+
+    sim = jnp.einsum(
+        "bhwid,bhwjd->bhwij", qw, kw2, preferred_element_type=jnp.float32
+    )
+    sim = sim * scale
+    mask = _window_mask(w)
+    sim = jnp.where(mask, sim, mask_value)
+    sim = sim - jax.lax.stop_gradient(sim.max(axis=-1, keepdims=True))
+    attn = jax.nn.softmax(sim, axis=-1).astype(q.dtype)
+
+    out = jnp.einsum("bhwij,bhwjd->bhwid", attn, vw2)
+    return out.reshape(b, h, n, d)
+
+
+def dense_local_attention_reference(q, k, v, *, window_size, scale=None):
+    """O(n^2) dense formulation of the same attention pattern, for tests.
+
+    Key j is visible to query i iff j <= i and i's window index minus j's
+    window index is at most 1. Shapes as in `local_attention`.
+    """
+    b, h, n, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+    i = jnp.arange(n)[:, None]
+    j = jnp.arange(n)[None, :]
+    visible = (j <= i) & ((i // window_size - j // window_size) <= 1)
+    sim = jnp.einsum("bhid,bhjd->bhij", q, k, preferred_element_type=jnp.float32)
+    sim = sim * scale
+    sim = jnp.where(visible, sim, ATTN_MASK_VALUE)
+    sim = sim - jax.lax.stop_gradient(sim.max(axis=-1, keepdims=True))
+    attn = jax.nn.softmax(sim, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhij,bhjd->bhid", attn, v)

@@ -1,0 +1,53 @@
+"""Rotary position embeddings, GPT-J interleaved layout.
+
+Semantics match the reference helpers at
+/root/reference/progen_transformer/progen.py:24-41: `inv_freq` over even dims,
+an outer product with positions, each frequency duplicated onto adjacent
+feature pairs, and the pairwise (-x2, x1) rotation. Implemented batch-first
+and dtype-aware: the sin/cos tables are built once in float32 (tables are
+cheap, precision matters) and cast to the compute dtype at application time.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def fixed_pos_embedding(seq_len: int, dim: int, offset: int = 0):
+    """Build (sin, cos) tables of shape (seq_len, dim) in float32.
+
+    `dim` must be even. Positions run offset..offset+seq_len (offset supports
+    incremental decoding and sequence-parallel shards, which see a slice of
+    the global position space).
+    """
+    inv_freq = 1.0 / (10000 ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    pos = jnp.arange(offset, offset + seq_len, dtype=jnp.float32)
+    sinusoid = jnp.einsum("i,j->ij", pos, inv_freq)
+    # duplicate each frequency onto the adjacent feature pair:
+    # (n, dim/2) -> (n, dim) with layout f0 f0 f1 f1 ...
+    sinusoid = jnp.repeat(sinusoid, 2, axis=-1)
+    return jnp.sin(sinusoid), jnp.cos(sinusoid)
+
+
+def rotate_every_two(x: jnp.ndarray) -> jnp.ndarray:
+    """(x1, x2, x3, x4, ...) -> (-x2, x1, -x4, x3, ...) over the last axis."""
+    x1 = x[..., ::2]
+    x2 = x[..., 1::2]
+    out = jnp.stack((-x2, x1), axis=-1)
+    return out.reshape(x.shape)
+
+
+def apply_rotary_pos_emb(x: jnp.ndarray, sin: jnp.ndarray, cos: jnp.ndarray):
+    """Apply RoPE over the last `rot_dim` features of x.
+
+    x: (..., n, d); sin/cos: (n, rot_dim) with rot_dim <= d. Features beyond
+    rot_dim pass through unrotated (progen.py:38-41).
+    """
+    rot_dim = sin.shape[-1]
+    sin = sin.astype(x.dtype)
+    cos = cos.astype(x.dtype)
+    x_rot, x_pass = x[..., :rot_dim], x[..., rot_dim:]
+    x_rot = x_rot * cos + rotate_every_two(x_rot) * sin
+    if x_pass.shape[-1] == 0:
+        return x_rot
+    return jnp.concatenate((x_rot, x_pass), axis=-1)

@@ -1,0 +1,32 @@
+"""Causal spatial mixing for the gMLP spatial gating unit.
+
+Reference math: /root/reference/progen_transformer/progen.py:166-184 — the
+gate half of the hidden is LayerNormed, mixed across the *sequence* axis by a
+learned causally-masked (n, n) matrix, offset by a per-position bias, and
+multiplies the residual half. This module holds the pure mixing op; the
+parameterized layer lives in progen_tpu/models/layers.py.
+
+The (n, n) weight is O(seq_len^2) parameters — the reference's long-context
+bottleneck (SURVEY.md section 5). The mix accumulates in float32 on the MXU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def causal_sgu_mix(gate: jnp.ndarray, weights: jnp.ndarray, biases: jnp.ndarray):
+    """gate: (..., n, d); weights: (n, n) [row m attends to columns <= m];
+    biases: (n, 1). Returns (..., n, d): out[m] = sum_{j<=m} W[m, j] gate[j] + b[m].
+
+    Matches einsum('n d, m n -> m d', gate, tril(W)) + b of the reference.
+    """
+    n = gate.shape[-2]
+    mask = jnp.tril(jnp.ones((n, n), dtype=bool))
+    w = jnp.where(mask, weights, 0).astype(jnp.float32)
+    mixed = jnp.einsum(
+        "...nd,mn->...md", gate.astype(jnp.float32), w,
+        preferred_element_type=jnp.float32,
+    )
+    mixed = mixed + biases.astype(jnp.float32)
+    return mixed.astype(gate.dtype)

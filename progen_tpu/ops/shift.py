@@ -1,0 +1,27 @@
+"""Token shift: half the feature channels are delayed one position.
+
+Reference: /root/reference/progen_transformer/progen.py:43-46 — split features
+in half, shift the first half one step along the sequence (pad front, drop
+last), re-concatenate. Batch-first here: operates on (..., n, d).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def shift_tokens(x: jnp.ndarray, shift_state: jnp.ndarray | None = None):
+    """x: (..., n, d). Returns same shape.
+
+    If `shift_state` is given (shape (..., 1, d//2 rounded like array_split)),
+    it is used as the value shifted into position 0 instead of zeros — the
+    hook incremental decoding uses to carry the previous token's features.
+    """
+    # np.array_split(x, 2) puts the extra column in the first half for odd d.
+    d = x.shape[-1]
+    split = d - d // 2
+    x_shift, x_pass = x[..., :split], x[..., split:]
+    if shift_state is None:
+        shift_state = jnp.zeros_like(x_shift[..., :1, :])
+    x_shift = jnp.concatenate((shift_state, x_shift[..., :-1, :]), axis=-2)
+    return jnp.concatenate((x_shift, x_pass), axis=-1)

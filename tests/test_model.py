@@ -1,0 +1,131 @@
+"""Model-level tests: shapes, causality, gmlp layer placement, dtypes."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import linen as nn
+
+from progen_tpu import ProGen, ProGenConfig
+
+TINY = ProGenConfig(
+    num_tokens=64,
+    dim=32,
+    seq_len=64,
+    depth=3,
+    window_size=16,
+    global_mlp_depth=1,
+    heads=2,
+    dim_head=16,
+    ff_mult=2,
+    dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_model_and_params():
+    model = ProGen(TINY)
+    tokens = jnp.zeros((1, TINY.seq_len), dtype=jnp.int32)
+    params = nn.meta.unbox(model.init(jax.random.PRNGKey(0), tokens))
+    return model, params
+
+
+def test_forward_shape(tiny_model_and_params):
+    model, params = tiny_model_and_params
+    tokens = jnp.ones((2, TINY.seq_len), dtype=jnp.int32)
+    logits = model.apply(params, tokens)
+    assert logits.shape == (2, TINY.seq_len, TINY.num_tokens)
+    assert logits.dtype == jnp.float32
+
+
+def test_causality(tiny_model_and_params):
+    """Changing token t must not change logits at positions < t."""
+    model, params = tiny_model_and_params
+    key = jax.random.PRNGKey(1)
+    tokens = jax.random.randint(key, (1, TINY.seq_len), 0, TINY.num_tokens)
+    logits = model.apply(params, tokens)
+    t = 29
+    tokens2 = tokens.at[0, t].set((tokens[0, t] + 1) % TINY.num_tokens)
+    logits2 = model.apply(params, tokens2)
+    np.testing.assert_allclose(logits[0, :t], logits2[0, :t], atol=1e-5)
+    # and the changed position itself must affect the future
+    assert not np.allclose(logits[0, t:], logits2[0, t:])
+
+
+def test_gmlp_on_trailing_layers_only(tiny_model_and_params):
+    """depth=3, global_mlp_depth=1 -> only ff2 has an SGU, ff0/ff1 are GLU
+    (progen.py:211-212: use_gmlp = (depth - i) <= global_mlp_depth)."""
+    _, params = tiny_model_and_params
+    p = params["params"]
+    assert "sgu" in p["ff2"]
+    assert "sgu" not in p["ff0"] and "sgu" not in p["ff1"]
+    # GLU doubles proj_in width on non-gmlp layers; SGU layers don't double
+    glu_width = p["ff0"]["proj_in"]["kernel"].shape[1]
+    sgu_width = p["ff2"]["proj_in"]["kernel"].shape[1]
+    assert glu_width == 2 * TINY.dim * TINY.ff_mult
+    assert sgu_width == TINY.dim * TINY.ff_mult
+    assert p["ff2"]["sgu"]["spatial_weights"].shape == (TINY.seq_len, TINY.seq_len)
+    assert p["ff2"]["sgu"]["spatial_biases"].shape == (TINY.seq_len, 1)
+
+
+def test_sgu_init(tiny_model_and_params):
+    _, params = tiny_model_and_params
+    w = params["params"]["ff2"]["sgu"]["spatial_weights"]
+    b = params["params"]["ff2"]["sgu"]["spatial_biases"]
+    bound = TINY.sgu_init_eps / TINY.seq_len
+    assert float(jnp.abs(w).max()) <= bound
+    np.testing.assert_allclose(b, jnp.ones_like(b))
+
+
+def test_num_params_closed_form(tiny_model_and_params):
+    _, params = tiny_model_and_params
+    actual = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    assert actual == TINY.num_params()
+
+
+def test_default_config_param_count():
+    # SURVEY.md section 2.1: shipped default config is ~27M params
+    cfg = ProGenConfig()  # reference defaults: dim=512 depth=6 seq=1024
+    n = cfg.num_params()
+    assert 26e6 < n < 29e6
+
+
+def test_bf16_compute_close_to_f32():
+    cfg_bf16 = ProGenConfig(**{**TINY.to_dict(), "dtype": "bfloat16"})
+    model32 = ProGen(TINY)
+    model16 = ProGen(cfg_bf16)
+    tokens = jnp.zeros((1, TINY.seq_len), dtype=jnp.int32)
+    params = model32.init(jax.random.PRNGKey(0), tokens)
+    l32 = model32.apply(params, tokens)
+    l16 = model16.apply(params, tokens)
+    assert l16.dtype == jnp.float32  # output policy: f32 logits
+    np.testing.assert_allclose(l32, l16, atol=0.15, rtol=0.15)
+
+
+def test_remat_matches():
+    cfg = ProGenConfig(**{**TINY.to_dict(), "remat": True})
+    model = ProGen(TINY)
+    model_r = ProGen(cfg)
+    tokens = jnp.zeros((2, TINY.seq_len), dtype=jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)
+
+    def loss(m):
+        return lambda p: m.apply(p, tokens).sum()
+
+    l1, g1 = jax.value_and_grad(loss(model))(params)
+    l2, g2 = jax.value_and_grad(loss(model_r))(params)
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
+    # remat recomputes activations -> different f32 reduction orders; compare
+    # with a relative tolerance scaled to each leaf's magnitude
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            a, b, rtol=1e-3, atol=1e-3 * (float(jnp.abs(a).max()) + 1e-6)
+        ),
+        g1,
+        g2,
+    )
+
+
+def test_seq_len_window_divisibility_enforced():
+    with pytest.raises(ValueError):
+        ProGenConfig(seq_len=100, window_size=32)

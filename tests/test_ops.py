@@ -1,0 +1,174 @@
+"""Unit tests for the core ops: RoPE, token shift, windowed local attention,
+and the SGU causal spatial mix."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from progen_tpu.ops.attention import (
+    dense_local_attention_reference,
+    local_attention,
+)
+from progen_tpu.ops.rotary import (
+    apply_rotary_pos_emb,
+    fixed_pos_embedding,
+    rotate_every_two,
+)
+from progen_tpu.ops.sgu import causal_sgu_mix
+from progen_tpu.ops.shift import shift_tokens
+
+
+class TestRotary:
+    def test_rotate_every_two(self):
+        x = jnp.arange(8, dtype=jnp.float32).reshape(1, 1, 8)
+        out = rotate_every_two(x)
+        # (x1, x2) -> (-x2, x1) pairwise
+        expected = jnp.array([-1.0, 0.0, -3.0, 2.0, -5.0, 4.0, -7.0, 6.0])
+        np.testing.assert_allclose(out[0, 0], expected)
+
+    def test_norm_preserved(self):
+        # rotation must preserve the norm of each feature pair
+        key = jax.random.PRNGKey(0)
+        x = jax.random.normal(key, (2, 4, 16, 32))
+        sin, cos = fixed_pos_embedding(16, 32)
+        out = apply_rotary_pos_emb(x, sin, cos)
+        np.testing.assert_allclose(
+            jnp.linalg.norm(out, axis=-1),
+            jnp.linalg.norm(x, axis=-1),
+            rtol=1e-5,
+        )
+
+    def test_relative_property(self):
+        # <RoPE_m(q), RoPE_n(k)> depends only on m - n
+        key = jax.random.PRNGKey(1)
+        q, k = jax.random.normal(key, (2, 1, 1, 64))
+        n = 32
+        sin, cos = fixed_pos_embedding(n, 64)
+        qr = apply_rotary_pos_emb(jnp.broadcast_to(q, (1, n, 64)), sin, cos)
+        kr = apply_rotary_pos_emb(jnp.broadcast_to(k, (1, n, 64)), sin, cos)
+        dots_gap3 = jnp.einsum("bd,bd->b", qr[0, 3:4], kr[0, 0:1])
+        dots_gap3_later = jnp.einsum("bd,bd->b", qr[0, 20:21], kr[0, 17:18])
+        np.testing.assert_allclose(dots_gap3, dots_gap3_later, rtol=1e-4)
+
+    def test_offset_matches_slice(self):
+        sin_full, cos_full = fixed_pos_embedding(64, 32)
+        sin_off, cos_off = fixed_pos_embedding(16, 32, offset=48)
+        np.testing.assert_allclose(sin_full[48:], sin_off, rtol=1e-6)
+        np.testing.assert_allclose(cos_full[48:], cos_off, rtol=1e-6)
+
+    def test_passthrough_dims(self):
+        x = jnp.ones((1, 8, 16))
+        sin, cos = fixed_pos_embedding(8, 8)  # rot_dim 8 < d 16
+        out = apply_rotary_pos_emb(x, sin, cos)
+        np.testing.assert_allclose(out[..., 8:], x[..., 8:])
+
+
+class TestShiftTokens:
+    def test_shift_semantics(self):
+        x = jnp.arange(24, dtype=jnp.float32).reshape(1, 4, 6)
+        out = shift_tokens(x)
+        # first half of features delayed one position, zeros shifted in
+        np.testing.assert_allclose(out[0, 0, :3], jnp.zeros(3))
+        np.testing.assert_allclose(out[0, 1:, :3], x[0, :-1, :3])
+        np.testing.assert_allclose(out[0, :, 3:], x[0, :, 3:])
+
+    def test_odd_features_split_like_array_split(self):
+        # np.array_split puts the larger piece first: d=5 -> shift 3, pass 2
+        x = jnp.ones((1, 3, 5))
+        out = shift_tokens(x)
+        assert float(out[0, 0, :3].sum()) == 0.0
+        assert float(out[0, 0, 3:].sum()) == 2.0
+
+    def test_shift_state_carried(self):
+        x = jnp.ones((1, 2, 4))
+        state = 7.0 * jnp.ones((1, 1, 2))
+        out = shift_tokens(x, shift_state=state)
+        np.testing.assert_allclose(out[0, 0, :2], jnp.array([7.0, 7.0]))
+
+
+class TestLocalAttention:
+    @pytest.mark.parametrize("window", [4, 8, 16])
+    def test_matches_dense_reference(self, window):
+        key = jax.random.PRNGKey(0)
+        kq, kk, kv = jax.random.split(key, 3)
+        shape = (2, 3, 32, 16)
+        q = jax.random.normal(kq, shape)
+        k = jax.random.normal(kk, shape)
+        v = jax.random.normal(kv, shape)
+        out = local_attention(q, k, v, window_size=window)
+        ref = dense_local_attention_reference(q, k, v, window_size=window)
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_causality(self):
+        key = jax.random.PRNGKey(1)
+        q, k, v = jax.random.normal(key, (3, 1, 2, 32, 8))
+        out = local_attention(q, k, v, window_size=8)
+        # perturb position t in k and v; outputs < t must not change
+        t = 17
+        k2 = k.at[:, :, t].add(10.0)
+        v2 = v.at[:, :, t].add(10.0)
+        out2 = local_attention(q, k2, v2, window_size=8)
+        np.testing.assert_allclose(out[:, :, :t], out2[:, :, :t], atol=1e-6)
+        assert not np.allclose(out[:, :, t:], out2[:, :, t:])
+
+    def test_window_locality(self):
+        # key more than one full window behind the query's window is invisible
+        key = jax.random.PRNGKey(2)
+        q, k, v = jax.random.normal(key, (3, 1, 1, 32, 8))
+        w = 8
+        out = local_attention(q, k, v, window_size=w)
+        # query at pos 31 (window 3) cannot see pos 0..15 (windows 0-1)
+        k2 = k.at[:, :, :16].add(100.0)
+        v2 = v.at[:, :, :16].add(100.0)
+        out2 = local_attention(q, k2, v2, window_size=w)
+        np.testing.assert_allclose(out[:, :, 24:], out2[:, :, 24:], atol=1e-6)
+
+    def test_bf16_inputs_f32_softmax(self):
+        key = jax.random.PRNGKey(3)
+        q, k, v = jax.random.normal(key, (3, 1, 2, 16, 8), dtype=jnp.bfloat16)
+        out = local_attention(q, k, v, window_size=8)
+        assert out.dtype == jnp.bfloat16
+        ref = local_attention(
+            q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32),
+            window_size=8,
+        )
+        np.testing.assert_allclose(
+            out.astype(jnp.float32), ref, atol=3e-2, rtol=3e-2
+        )
+
+    def test_grads_flow(self):
+        key = jax.random.PRNGKey(4)
+        q, k, v = jax.random.normal(key, (3, 1, 1, 16, 4))
+
+        def f(q, k, v):
+            return local_attention(q, k, v, window_size=4).sum()
+
+        gq, gk, gv = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        assert jnp.isfinite(gq).all() and jnp.isfinite(gk).all()
+        assert jnp.isfinite(gv).all()
+        # position 0 key gets gradient (it is attended by queries 0..7)
+        assert float(jnp.abs(gk[:, :, 0]).sum()) > 0
+
+
+class TestSGU:
+    def test_causal_mix(self):
+        n, d = 8, 4
+        gate = jnp.ones((1, n, d))
+        w = jnp.ones((n, n))
+        b = jnp.zeros((n, 1))
+        out = causal_sgu_mix(gate, w, b)
+        # row m sums m+1 ones
+        np.testing.assert_allclose(out[0, :, 0], jnp.arange(1, n + 1.0))
+
+    def test_matches_reference_einsum(self):
+        key = jax.random.PRNGKey(0)
+        n, d = 16, 8
+        gate = jax.random.normal(key, (n, d))
+        w = jax.random.normal(jax.random.PRNGKey(1), (n, n))
+        b = jax.random.normal(jax.random.PRNGKey(2), (n, 1))
+        # reference formulation (progen.py:178-182), single sequence
+        wm = w * jnp.tril(jnp.ones((n, n)))
+        expected = jnp.einsum("nd,mn->md", gate, wm) + b
+        out = causal_sgu_mix(gate[None], w, b)[0]
+        np.testing.assert_allclose(out, expected, atol=1e-5)
