@@ -1,0 +1,140 @@
+"""Throughput benchmark — training tokens/sec/chip + MFU on the real chip.
+
+Runs the full donated train step (grad-accum scan + clip + masked AdamW) on
+the flagship ProGen-tiny config (README example, BASELINE.md config 1) with
+synthetic data, and prints ONE JSON line:
+  {"metric": "train_tokens_per_sec_per_chip", "value": ..., "unit":
+   "tokens/s/chip", "vs_baseline": ...}
+
+vs_baseline: the reference publishes no numbers (BASELINE.md — README "(wip)",
+no benchmarks/ dir), so the denominator is this repo's own recorded round-1
+number when present (BENCH_r*.json), else 1.0 (i.e. the value itself is the
+baseline being established).
+
+MFU accounting (extra keys, PaLM convention): flops/token =
+6*num_params + 12*depth*heads*dim_head*attn_ctx with attn_ctx = 2*window
+(each query attends to [prev | current] window). Peak: v5e 197 TFLOP/s bf16,
+v4 275, v5p 459; selected by device kind, default 197.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import time
+
+import numpy as np
+
+PEAK_BF16_FLOPS = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5 lite": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+}
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    for key, val in PEAK_BF16_FLOPS.items():
+        if key in kind or (gen and key in gen):
+            return val
+    return 197e12
+
+
+def _prior_round_value() -> float | None:
+    best = None
+    for path in sorted(glob.glob("BENCH_r*.json")):
+        try:
+            rec = json.loads(open(path).read())
+        except (OSError, json.JSONDecodeError):
+            continue
+        parsed = rec.get("parsed") if isinstance(rec, dict) else None
+        if isinstance(parsed, dict) and parsed.get("metric", "").startswith(
+            "train_tokens"
+        ):
+            best = parsed.get("value", best)
+    return best
+
+
+def main() -> None:
+    import jax
+
+    from progen_tpu.config import ProGenConfig
+    from progen_tpu.models.progen import ProGen
+    from progen_tpu.parallel.partition import make_mesh, put_batch
+    from progen_tpu.training.optimizer import make_optimizer
+    from progen_tpu.training.step import compile_train_step, init_train_state
+
+    config = ProGenConfig(
+        num_tokens=256,
+        dim=512,
+        depth=12,
+        heads=8,
+        dim_head=64,
+        window_size=256,
+        seq_len=1024,
+        global_mlp_depth=2,
+        dtype="bfloat16",
+    )
+    n_chips = len(jax.devices())
+    mesh = make_mesh()  # all devices on the data axis (1 on the bench chip)
+    model = ProGen(config)
+    optimizer = make_optimizer()
+    state, shardings = init_train_state(
+        model, optimizer, jax.random.PRNGKey(0), config.seq_len, mesh=mesh
+    )
+    step = compile_train_step(model, optimizer, state, shardings, mesh)
+
+    grad_accum, micro_bs = 4, 4 * n_chips  # reference recipe: 4 x 4
+    rng = np.random.default_rng(0)
+    batch = rng.integers(
+        1, 256, size=(grad_accum, micro_bs, config.seq_len + 1)
+    ).astype(np.int32)
+
+    with mesh:
+        device_batch = put_batch(batch, mesh, accum_axis=True)
+        # warmup/compile
+        state, metrics = step(state, device_batch)
+        jax.block_until_ready(metrics["loss"])
+
+        n_iters = 10
+        t0 = time.perf_counter()
+        for _ in range(n_iters):
+            state, metrics = step(state, device_batch)
+        jax.block_until_ready(metrics["loss"])
+        dt = time.perf_counter() - t0
+
+    tokens_per_step = grad_accum * micro_bs * config.seq_len
+    tokens_per_sec = tokens_per_step * n_iters / dt
+    per_chip = tokens_per_sec / n_chips
+
+    num_params = state.num_params()
+    flops_per_token = (
+        6 * num_params
+        + 12 * config.depth * config.heads * config.dim_head
+        * (2 * config.window_size)
+    )
+    mfu = per_chip * flops_per_token / _peak_flops(jax.devices()[0])
+
+    prior = _prior_round_value()
+    result = {
+        "metric": "train_tokens_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(per_chip / prior, 3) if prior else 1.0,
+        "mfu": round(mfu, 4),
+        "num_params": num_params,
+        "chips": n_chips,
+        "step_ms": round(1000 * dt / n_iters, 1),
+        "config": "progen-tiny (dim=512 depth=12 seq=1024 w=256) bf16",
+        "platform": jax.devices()[0].platform,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

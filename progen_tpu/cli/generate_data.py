@@ -1,0 +1,36 @@
+"""Data-generation CLI: FASTA -> TFRecord shards.
+
+Parity with /root/reference/generate_data.py:160-172 (same flags, same TOML
+schema) without the Prefect DAG wrapper — the two ETL stages are plain
+functions in progen_tpu/data/fasta.py.
+
+Run: python -m progen_tpu.cli.generate_data --data_dir ./configs/data
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import click
+
+
+@click.command()
+@click.option("--data_dir", default="./configs/data")
+@click.option("--name", default="default")
+@click.option("--seed", default=None, type=int, help="seedable ETL (additive)")
+def main(data_dir, name, seed):
+    from progen_tpu.config import load_toml_config
+    from progen_tpu.data.fasta import generate_data
+
+    config_path = Path(data_dir) / f"{name}.toml"
+    assert config_path.exists(), f"config does not exist at {config_path}"
+    config = load_toml_config(str(config_path))
+    written = generate_data(config, seed=seed)
+    total = len(written)
+    print(f"wrote {total} tfrecord shard(s):")
+    for path in written:
+        print(f"  {path}")
+
+
+if __name__ == "__main__":
+    main()

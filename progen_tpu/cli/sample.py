@@ -1,0 +1,66 @@
+"""Sampling CLI — generate a protein sequence from the latest checkpoint.
+
+Parity with /root/reference/sample.py:23-71: the model is rebuilt purely
+from the checkpoint's stored config (sample.py:46-47), the prime is
+byte-tokenized, decode runs with top_k=25 and add_bos=True, and the output
+after the prime is printed. Prime conventions (README.md:82-86):
+``"[tax=Mammalia] #"`` generates a sequence; ``"SEQ #"`` generates
+annotations.
+
+Run: python -m progen_tpu.cli.sample --prime "[tax=Mammalia] #"
+"""
+
+from __future__ import annotations
+
+import sys
+
+import click
+import numpy as np
+
+import jax
+
+
+@click.command()
+@click.option("--seed", default=42)
+@click.option("--checkpoint_path", default="./ckpts")
+@click.option("--prime", default="")
+@click.option("--top_k", default=25)
+def main(seed, checkpoint_path, prime, top_k):
+    from progen_tpu.checkpoint import get_checkpoint_fns
+    from progen_tpu.config import ProGenConfig
+    from progen_tpu.data.tokenizer import decode_tokens, encode_tokens
+    from progen_tpu.models.progen import ProGen
+    from progen_tpu.sampling import sample
+
+    _, get_last, _ = get_checkpoint_fns(checkpoint_path)
+    pkg = get_last()
+    if pkg is None:
+        sys.exit(f"no checkpoints found at {checkpoint_path}")
+
+    config = ProGenConfig.from_dict(pkg.model_config)
+    model = ProGen(config)
+    params = pkg.state["params"] if isinstance(pkg.state, dict) else pkg.state.params
+
+    num_params = sum(int(np.size(x)) for x in jax.tree.leaves(params))
+    print(f"params: {num_params:,}")
+    print(f"sequence length: {config.seq_len}")
+    print(f"trained for {max(pkg.next_seq_index, 0):,} sequences")
+
+    prime_tokens = np.asarray(encode_tokens(prime), dtype=np.int32)
+    prime_length = len(prime_tokens) + 1  # +1 for BOS (sample.py:67)
+
+    sampled = sample(
+        jax.random.PRNGKey(seed),
+        model,
+        params,
+        prime_tokens,
+        config.seq_len,
+        top_k=top_k,
+        add_bos=True,
+    )
+    sampled_str = decode_tokens(np.asarray(sampled)[prime_length:])
+    print("\n", prime, "\n", "*" * 40, "\n", sampled_str)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,282 @@
+"""Training CLI.
+
+Flag-set parity with /root/reference/train.py:36-57 (same names, same
+defaults), plus TPU-native mesh knobs (--mesh_data/--mesh_seq/--mesh_model)
+the reference's single-host pmap had no equivalent for
+(--data_parallel maps to "shard the data axis over every device").
+
+Loop semantics (/root/reference/train.py:179-222): iterate sequence indices
+in effective-batch strides; checkpoint / validate / sample on their
+cadences; resume from the latest checkpoint (config-in-checkpoint overrides
+the TOML, train.py:94-100); --new wipes after interactive confirmation.
+
+Run: python -m progen_tpu.cli.train [flags]
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import click
+import numpy as np
+
+import jax
+
+
+def confirm(question: str) -> bool:
+    """Interactive y/n guard for --new (train.py:85-88 semantics)."""
+    return input(f"{question} (y/n) ").strip().lower() == "y"
+
+
+@click.command()
+@click.option("--seed", default=42)
+@click.option("--batch_size", default=4)
+@click.option("--grad_accum_every", default=4)
+@click.option("--learning_rate", default=2e-4)
+@click.option("--weight_decay", default=1e-3)
+@click.option("--data_parallel", default=False, is_flag=True)
+@click.option("--max_grad_norm", default=0.5)
+@click.option("--validate_every", default=100)
+@click.option("--sample_every", default=500)
+@click.option("--checkpoint_every", default=1000)
+@click.option("--checkpoint_path", default="./ckpts")
+@click.option("--checkpoint_keep_n", default=500)
+@click.option("--config_path", default="./configs/model")
+@click.option("--model_name", default="default")
+@click.option("--prime_length", default=25)
+@click.option("--seq_len", default=1024)
+@click.option("--mixed_precision", default=False, is_flag=True)
+@click.option("--data_path", default="./train_data")
+@click.option("--wandb_off", default=False, is_flag=True)
+@click.option("--wandb_project_name", default="progen-training")
+@click.option("--new", default=False, is_flag=True)
+@click.option("--mesh_data", default=0, help="data-parallel mesh axis size (0 = auto)")
+@click.option("--mesh_seq", default=1, help="sequence-parallel mesh axis size")
+@click.option("--mesh_model", default=1, help="tensor-parallel mesh axis size")
+@click.option("--num_steps", default=0, help="stop after N optimizer steps (0 = full data)")
+def main(
+    seed,
+    batch_size,
+    grad_accum_every,
+    learning_rate,
+    weight_decay,
+    data_parallel,
+    max_grad_norm,
+    validate_every,
+    sample_every,
+    checkpoint_every,
+    checkpoint_path,
+    checkpoint_keep_n,
+    config_path,
+    model_name,
+    prime_length,
+    seq_len,
+    mixed_precision,
+    data_path,
+    wandb_off,
+    wandb_project_name,
+    new,
+    mesh_data,
+    mesh_seq,
+    mesh_model,
+    num_steps,
+):
+    from progen_tpu.checkpoint import Package, get_checkpoint_fns
+    from progen_tpu.config import ProGenConfig, load_toml_config
+    from progen_tpu.data.dataset import iterator_from_tfrecords_folder
+    from progen_tpu.data.tokenizer import decode_tokens
+    from progen_tpu.models.progen import ProGen
+    from progen_tpu.parallel.partition import (
+        initialize_distributed,
+        is_coordinator,
+        make_mesh,
+        put_batch,
+    )
+    from progen_tpu.sampling import sample as sample_tokens
+    from progen_tpu.tracking import make_tracker, render_sample_html
+    from progen_tpu.training.optimizer import make_optimizer
+    from progen_tpu.training.step import (
+        abstract_train_state,
+        compile_train_step,
+        init_train_state,
+        compile_eval_step,
+    )
+
+    initialize_distributed()
+
+    reset_ckpt, get_last, save_ckpt = get_checkpoint_fns(
+        checkpoint_path, keep_last_n=checkpoint_keep_n
+    )
+    if new:
+        if not confirm(
+            "are you sure you want to clear all your checkpoints and "
+            "restart training?"
+        ):
+            sys.exit(0)
+        reset_ckpt()
+
+    # --- model config: checkpoint overrides TOML on resume (train.py:94-100)
+    last_meta = get_last.peek()  # metadata only; arrays restored sharded below
+    if last_meta is None:
+        toml_path = Path(config_path) / f"{model_name}.toml"
+        assert toml_path.exists(), f"model config not found: {toml_path}"
+        model_kwargs = load_toml_config(str(toml_path))
+    else:
+        model_kwargs = last_meta.model_config
+    model_kwargs.setdefault("seq_len", seq_len)
+    # reference semantics (train.py:53,106): full f32 unless --mixed_precision
+    # opts into the fast dtype; an explicit TOML dtype wins when flag absent
+    config = ProGenConfig.from_dict(
+        {**model_kwargs, "dtype": "bfloat16" if mixed_precision
+         else model_kwargs.get("dtype", "float32")}
+    )
+
+    model = ProGen(config)
+    optimizer = make_optimizer(learning_rate, weight_decay, max_grad_norm)
+
+    # --- mesh: data_parallel -> absorb all devices on the data axis
+    if mesh_data == 0:
+        mesh_data = -1 if (data_parallel or mesh_seq * mesh_model > 1) else 1
+    mesh = make_mesh(data=mesh_data, seq=mesh_seq, model=mesh_model)
+
+    # --- state: cold init or sharded restore (never both)
+    start_seq_index, run_id = 0, None
+    if last_meta is None:
+        state, shardings = init_train_state(
+            model, optimizer, jax.random.PRNGKey(seed), config.seq_len,
+            mesh=mesh,
+        )
+    else:
+        from progen_tpu.checkpoint import sharded_abstract_state
+        from progen_tpu.parallel.partition import state_shardings
+
+        boxed, abstract = abstract_train_state(
+            model, optimizer, config.seq_len
+        )
+        shardings = state_shardings(boxed, mesh)
+        pkg = get_last(sharded_abstract_state(abstract, shardings))
+        state = pkg.state
+        start_seq_index = pkg.next_seq_index
+        run_id = pkg.run_id
+
+    tracker = make_tracker(
+        wandb_project_name, run_id, disabled=wandb_off
+    )
+    run_id = tracker.run_id or run_id
+    num_params = state.num_params()
+    tracker.set_config({**config.to_dict(), "num_params": num_params})
+
+    # --- data
+    num_train, train_iter_fn = iterator_from_tfrecords_folder(data_path)
+    num_valid, valid_iter_fn = iterator_from_tfrecords_folder(
+        data_path, "valid"
+    )
+    assert num_train > 0 and num_valid > 0, "no training/validation data"
+    proc_kwargs = dict(
+        process_index=jax.process_index(), process_count=jax.process_count()
+    )
+    train_ds = train_iter_fn(
+        config.seq_len,
+        batch_size,
+        skip=start_seq_index,
+        loop=True,
+        **proc_kwargs,
+    )
+    valid_ds = valid_iter_fn(
+        config.seq_len, batch_size, loop=True, **proc_kwargs
+    )
+
+    if is_coordinator():
+        print(f"params: {num_params:,}")
+        print(f"train sequences: {num_train:,}  valid: {num_valid:,}")
+
+    train_step = compile_train_step(model, optimizer, state, shardings, mesh)
+    eval_step = compile_eval_step(model, shardings, mesh)
+
+    effective_batch = batch_size * grad_accum_every
+    sample_rng = jax.random.PRNGKey(seed + 1)
+
+    local_bs = batch_size // jax.process_count()
+
+    def pad_rows(m):
+        # ragged tails (end of data) are padded up to the local batch size
+        # with 0-rows so every process contributes identical shapes to the
+        # global array; a 0-row adds one EOS position to the loss mask
+        return np.pad(m, ((0, local_bs - m.shape[0]), (0, 0)))
+
+    def next_super_batch():
+        micro = [pad_rows(next(train_ds)) for _ in range(grad_accum_every)]
+        return put_batch(np.stack(micro), mesh, accum_axis=True)
+
+    import tqdm
+
+    seq_indices = range(start_seq_index, num_train, effective_batch)
+    steps_done = 0
+    with mesh:
+        for i, seq_index in enumerate(tqdm.tqdm(seq_indices, mininterval=10)):
+            if num_steps and steps_done >= num_steps:
+                break
+            state, metrics = train_step(state, next_super_batch())
+            steps_done += 1
+            loss = float(metrics["last_micro_loss"])
+            if is_coordinator():
+                print(f"loss: {loss:.4f}")
+            tracker.log({"loss": loss}, step=i)
+
+            next_seq_index = seq_index + effective_batch
+            if i % checkpoint_every == 0:
+                save_ckpt(
+                    Package(
+                        next_seq_index=next_seq_index,
+                        state=state,
+                        model_config=config.to_dict(),
+                        run_id=run_id,
+                    )
+                )
+            if i % validate_every == 0:
+                vloss = float(
+                    eval_step(
+                        state, put_batch(pad_rows(next(valid_ds)), mesh)
+                    )
+                )
+                if is_coordinator():
+                    print(f"valid_loss: {vloss:.4f}")
+                tracker.log({"valid_loss": vloss}, step=i)
+            if i % sample_every == 0:
+                valid_batch = np.asarray(next(valid_ds))
+                prime = valid_batch[0, 1 : prime_length + 1]  # skip BOS col
+                sampled = sample_tokens(
+                    jax.random.fold_in(sample_rng, i),
+                    model,
+                    state.params,
+                    prime,
+                    config.seq_len,
+                    top_k=25,
+                    add_bos=True,
+                )
+                prime_str = decode_tokens(prime)
+                sampled_str = decode_tokens(np.asarray(sampled)[prime_length + 1 :])
+                if is_coordinator():
+                    print(f"sample: {sampled_str[:120]}")
+                tracker.log_html(
+                    "samples",
+                    render_sample_html(prime_str, sampled_str),
+                    step=i,
+                )
+
+    # final checkpoint so short runs (e.g. --num_steps) always persist;
+    # next_seq_index counts exactly the records consumed by executed steps
+    save_ckpt(
+        Package(
+            next_seq_index=start_seq_index + steps_done * effective_batch,
+            state=state,
+            model_config=config.to_dict(),
+            run_id=run_id,
+        )
+    )
+    tracker.finish()
+
+
+if __name__ == "__main__":
+    main()

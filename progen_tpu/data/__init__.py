@@ -1,0 +1,19 @@
+from progen_tpu.data.tokenizer import (
+    PAD_ID,
+    decode_tokens,
+    encode_tokens,
+)
+from progen_tpu.data.tfrecord import (
+    read_tfrecords,
+    tfrecord_writer,
+)
+from progen_tpu.data.dataset import iterator_from_tfrecords_folder
+
+__all__ = [
+    "PAD_ID",
+    "encode_tokens",
+    "decode_tokens",
+    "read_tfrecords",
+    "tfrecord_writer",
+    "iterator_from_tfrecords_folder",
+]

@@ -1,0 +1,182 @@
+"""FASTA -> TFRecord ETL.
+
+Capability target (/root/reference/generate_data.py): read a (Uniref50)
+FASTA, filter by max length, cap sample count, turn each record into
+training strings with the taxonomy-annotation grammar, then shuffle-split
+into train/valid TFRecord shards named ``{i}.{count}.{split}.tfrecord.gz``.
+
+Annotation grammar parity (generate_data.py:37-79):
+  * taxonomy extracted from the description with
+    ``Tax=([a-zA-Z\\s]*)\\s[a-zA-Z\\=]`` (note the trailing context — the
+    match stops one token before the next ``Key=`` field);
+  * annotated string ``"[tax=X] # SEQ"``, with annotation and sequence
+    swapped with probability ``prob_invert_seq_annotation``;
+  * an unannotated ``"# SEQ"`` is ALWAYS also emitted, so every protein
+    appears at least once without conditioning.
+
+Deltas from the reference, all deliberate:
+  * no Prefect/pyfaidx — a streaming FASTA parser (no index build, one pass)
+    and plain functions; sequences are NOT spilled one-file-per-string to a
+    tmp dir (generate_data.py:76-79) but kept in a list (25k strings is MBs);
+  * the reference's ``from random import random`` shadowing bug (its
+    ``random.shuffle`` crashes when sort_annotations=false,
+    generate_data.py:5,14,55) is fixed by using an explicit
+    ``random.Random`` instance, which also makes the ETL seedable;
+  * GCS upload accepts any ``gs://`` write path via the same client the
+    checkpointer uses (optional import, local-FS first).
+"""
+
+from __future__ import annotations
+
+import gzip
+import random as _random
+import re
+from math import ceil
+from pathlib import Path
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from progen_tpu.data.tfrecord import tfrecord_writer
+
+_TAX_RE = re.compile(r"Tax=([a-zA-Z\s]*)\s[a-zA-Z\=]")
+
+
+def parse_fasta(path: str) -> Iterator[Tuple[str, str]]:
+    """Stream (description, sequence) pairs; sequences uppercased
+    (pyfaidx ``sequence_always_upper`` parity, generate_data.py:92)."""
+    opener = gzip.open if str(path).endswith(".gz") else open
+    desc: Optional[str] = None
+    chunks: List[str] = []
+    with opener(path, "rt") as fp:
+        for line in fp:
+            line = line.strip()
+            if not line:
+                continue
+            if line.startswith(">"):
+                if desc is not None:
+                    yield desc, "".join(chunks).upper()
+                desc = line[1:]
+                chunks = []
+            else:
+                chunks.append(line)
+        if desc is not None:
+            yield desc, "".join(chunks).upper()
+
+
+def annotations_from_description(description: str) -> Dict[str, str]:
+    """{'tax': <taxonomy>} when present (generate_data.py:37-44)."""
+    m = _TAX_RE.findall(description)
+    return {"tax": m[0]} if m else {}
+
+
+def sequence_strings(
+    description: str,
+    seq: str,
+    *,
+    prob_invert_seq_annotation: float,
+    sort_annotations: bool,
+    rng: _random.Random,
+) -> List[bytes]:
+    """The training strings for one FASTA record (generate_data.py:46-79)."""
+    out: List[bytes] = []
+    annotations = annotations_from_description(description)
+    if annotations:
+        keys = list(annotations.keys())
+        if sort_annotations:
+            keys = sorted(keys)
+        else:
+            rng.shuffle(keys)
+        annot_str = " ".join(f"[{k}={annotations[k]}]" for k in keys)
+        pair = (annot_str, seq)
+        if rng.random() <= prob_invert_seq_annotation:
+            pair = tuple(reversed(pair))
+        out.append(" # ".join(pair).encode("utf-8"))
+    out.append(f"# {seq}".encode("utf-8"))
+    return out
+
+
+def write_tfrecord_shards(
+    sequences: List[bytes],
+    write_to: str,
+    *,
+    fraction_valid_data: float,
+    num_sequences_per_file: int,
+    seed: Optional[int] = None,
+) -> List[str]:
+    """Permute, split train/valid, shard into
+    ``{file_index}.{count}.{split}.tfrecord.gz`` (generate_data.py:115-149).
+    Returns the written paths."""
+    n = len(sequences)
+    num_valid = ceil(fraction_valid_data * n)
+    perm = np.random.RandomState(seed).permutation(n)
+    valid_idx, train_idx = np.split(perm, [num_valid])
+
+    gcs_bucket = None
+    staging = None
+    if write_to.startswith("gs://"):
+        import tempfile
+
+        from google.cloud import storage
+
+        bucket_name, _, prefix = write_to[len("gs://") :].partition("/")
+        gcs_bucket = storage.Client().get_bucket(bucket_name)
+        staging = tempfile.TemporaryDirectory(prefix="tfrecord_staging_")
+        local_dir = Path(staging.name)
+    else:
+        local_dir = Path(write_to)
+        prefix = ""
+    local_dir.mkdir(parents=True, exist_ok=True)
+
+    written: List[str] = []
+    for split, idx in (("train", train_idx), ("valid", valid_idx)):
+        if len(idx) == 0:
+            continue
+        num_files = ceil(len(idx) / num_sequences_per_file)
+        for file_index, shard in enumerate(np.array_split(idx, num_files)):
+            name = f"{file_index}.{len(shard)}.{split}.tfrecord.gz"
+            path = local_dir / name
+            with tfrecord_writer(str(path)) as write:
+                for i in shard:
+                    write(sequences[int(i)])
+            if gcs_bucket is not None:
+                blob_name = f"{prefix}/{name}" if prefix else name
+                gcs_bucket.blob(blob_name).upload_from_filename(str(path))
+                written.append(f"gs://{gcs_bucket.name}/{blob_name}")
+            else:
+                written.append(str(path))
+    if staging is not None:
+        staging.cleanup()
+    return written
+
+
+def generate_data(config: dict, *, seed: Optional[int] = None) -> List[str]:
+    """Full ETL with the reference TOML schema
+    (/root/reference/configs/data/default.toml): read_from, write_to,
+    num_samples, max_seq_len, prob_invert_seq_annotation,
+    fraction_valid_data, num_sequences_per_file, sort_annotations."""
+    rng = _random.Random(seed)
+    sequences: List[bytes] = []
+    kept = 0
+    for desc, seq in parse_fasta(config["read_from"]):
+        if len(seq) > config["max_seq_len"]:
+            continue
+        sequences.extend(
+            sequence_strings(
+                desc,
+                seq,
+                prob_invert_seq_annotation=config["prob_invert_seq_annotation"],
+                sort_annotations=config["sort_annotations"],
+                rng=rng,
+            )
+        )
+        kept += 1
+        if kept >= config["num_samples"]:
+            break
+    return write_tfrecord_shards(
+        sequences,
+        config["write_to"],
+        fraction_valid_data=config["fraction_valid_data"],
+        num_sequences_per_file=config["num_sequences_per_file"],
+        seed=seed,
+    )

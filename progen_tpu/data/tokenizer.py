@@ -1,0 +1,28 @@
+"""Byte-level protein tokenizer.
+
+Contract (/root/reference/progen_transformer/data.py:76-88): token =
+``ord(char) + 1``; id 0 is reserved and triple-duty as BOS / padding / EOS
+(the loss learns EOS from the first pad position, see training/loss.py).
+Decoding subtracts the offset and drops any id that falls below zero (pads
+vanish). Vocab size is therefore 256 (`num_tokens` in the model config) —
+bytes 0..254 shifted up by one.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+PAD_ID = 0  # also BOS and EOS
+OFFSET = 1
+
+
+def encode_tokens(text: str) -> np.ndarray:
+    """str -> int32 token ids (no BOS prepended; the data pipeline adds it)."""
+    raw = np.frombuffer(text.encode("utf-8"), dtype=np.uint8)
+    return raw.astype(np.int32) + OFFSET
+
+
+def decode_tokens(tokens, offset: int = OFFSET) -> str:
+    """Token ids -> str. Ids below ``offset`` (pad/BOS/EOS) decode to ''."""
+    toks = np.asarray(tokens, dtype=np.int64).reshape(-1) - offset
+    return "".join(chr(t) for t in toks if t >= 0)

@@ -13,9 +13,8 @@ Differences from the reference are deliberate TPU choices, not omissions:
     (bf16-safe), output is cast back to the input dtype;
   * the mask is built once at trace time as a constant.
 
-A Pallas flash-style kernel for the same math lives in
-progen_tpu/ops/pallas_attention.py; this module is the golden reference the
-kernel is validated against.
+This module is the golden reference the Pallas flash-style kernel
+(progen_tpu/ops/pallas_attention.py, when present) is validated against.
 """
 
 from __future__ import annotations
@@ -60,8 +59,12 @@ def local_attention(
     kw = k.reshape(b, h, nw, w, d)
     vw = v.reshape(b, h, nw, w, d)
 
-    # Each window's keys/values = [previous window | current window]; the
-    # previous window of window 0 is zeros (masked out anyway).
+    # Each window's keys/values = [previous window | current window]. The
+    # previous window of window 0 is zeros, and the (w, 2w) mask does NOT
+    # exclude those padded keys (j <= i + w admits all of them), so window-0
+    # queries deliberately leak softmax mass to w zero-score/zero-value keys —
+    # exactly the reference behavior (progen.py:90-96). The dense golden below
+    # models the same dilution.
     def with_prev(t):
         prev = jnp.pad(t[:, :, :-1], ((0, 0), (0, 0), (1, 0), (0, 0), (0, 0)))
         return jnp.concatenate((prev, t), axis=3)  # (b, h, nw, 2w, d)
@@ -85,17 +88,29 @@ def dense_local_attention_reference(q, k, v, *, window_size, scale=None):
     """O(n^2) dense formulation of the same attention pattern, for tests.
 
     Key j is visible to query i iff j <= i and i's window index minus j's
-    window index is at most 1. Shapes as in `local_attention`.
+    window index is at most 1. Additionally — upstream-parity quirk — queries
+    in window 0 see `window_size` phantom keys with score 0 and value 0 (the
+    zero-padded "previous window" of progen.py:90-96, which the (w, 2w) mask
+    does not exclude), so their softmax mass is diluted by w exp(0) terms.
+    Shapes as in `local_attention`.
     """
     b, h, n, d = q.shape
+    w = window_size
     if scale is None:
         scale = d ** -0.5
     i = jnp.arange(n)[:, None]
     j = jnp.arange(n)[None, :]
-    visible = (j <= i) & ((i // window_size - j // window_size) <= 1)
+    visible = (j <= i) & ((i // w - j // w) <= 1)
     sim = jnp.einsum("bhid,bhjd->bhij", q, k, preferred_element_type=jnp.float32)
     sim = sim * scale
     sim = jnp.where(visible, sim, ATTN_MASK_VALUE)
+    # Phantom zero-key columns: score 0 for window-0 queries, masked elsewhere.
+    # Their values are zero, so after softmax they only dilute the real rows.
+    phantom = jnp.where(i < w, 0.0, ATTN_MASK_VALUE)  # (n, w) via broadcast
+    phantom = jnp.broadcast_to(phantom, (n, w))
+    sim = jnp.concatenate(
+        (jnp.broadcast_to(phantom, sim.shape[:-1] + (w,)), sim), axis=-1
+    )
     sim = sim - jax.lax.stop_gradient(sim.max(axis=-1, keepdims=True))
     attn = jax.nn.softmax(sim, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhij,bhjd->bhid", attn, v)
+    return jnp.einsum("bhij,bhjd->bhid", attn[..., w:], v)

@@ -1,0 +1,15 @@
+from progen_tpu.parallel.partition import (
+    DEFAULT_RULES,
+    make_mesh,
+    logical_rules,
+    param_shardings,
+    state_shardings,
+)
+
+__all__ = [
+    "DEFAULT_RULES",
+    "make_mesh",
+    "logical_rules",
+    "param_shardings",
+    "state_shardings",
+]

@@ -1,0 +1,206 @@
+"""Mesh construction + the logical→mesh sharding rule table.
+
+This is the single place where the logical axis names scattered through the
+model (see progen_tpu/models/layers.py, progen_tpu/models/progen.py) are bound
+to physical mesh axes. The reference's entire distribution story is a
+single-host `pmap` (/root/reference/progen_transformer/utils.py:70); here the
+equivalent and its superset are expressed as a `jax.sharding.Mesh` over up to
+three axes:
+
+  * ``data``  — batch-parallel axis (DP). Gradients are reduced over it by
+    GSPMD-inserted collectives (the psum the reference leaves implicit in the
+    pmap transpose).
+  * ``model`` — tensor-parallel axis (the reference's open TODO,
+    /root/reference/README.md:104). QKV/FF projections are sharded
+    Megatron-style: column-parallel in, row-parallel out, so each
+    attention+FF block needs exactly one all-reduce on its output.
+  * ``seq``   — sequence-parallel axis for long-context configs: activations
+    are sharded along the sequence; the windowed attention only needs its
+    previous window as halo, so the collective footprint is one
+    `ppermute`-shaped exchange per layer (see ops/attention docs).
+
+Rule-table decisions (each is deliberate):
+  * ``embed`` (feature dim of residual stream weights) is replicated — the
+    residual stream stays whole so LayerNorms need no collective.
+  * ``qkv`` / ``mlp`` (projection output dims) shard over ``model``.
+  * ``vocab`` shards the embedding + logits head over ``model`` (the largest
+    single matrices at 1.2B scale).
+  * SGU spatial ``(n, n)`` weights shard their *output* sequence axis over
+    ``seq`` and replicate over ``model`` — the matrix is sequence-structured,
+    not head-structured, and row-sharding it matches a sequence-sharded
+    activation layout (out[m] only needs local rows m).
+  * activations: ``batch``→data, ``seq_act``→seq, ``mlp_act``→model,
+    ``embed_act`` replicated.
+
+Multi-host: `initialize_distributed` wraps `jax.distributed.initialize`;
+`make_mesh` builds a hybrid DCN×ICI layout when multiple slices are present
+(data-parallel outermost over DCN, model-parallel innermost over ICI, the
+standard TPU recipe).
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from flax import linen as nn
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+MESH_AXES = ("data", "seq", "model")
+
+# logical axis name -> mesh axis (None = replicate). Order matters only for
+# readability; flax resolves each logical name independently.
+DEFAULT_RULES = (
+    # --- weights ---
+    ("vocab", "model"),
+    ("embed", None),
+    ("qkv", "model"),
+    ("mlp", "model"),
+    ("sgu_hidden", None),
+    ("sgu_seq_out", "seq"),
+    ("sgu_seq_in", None),
+    # --- activations ---
+    ("batch", "data"),
+    ("seq_act", "seq"),
+    ("embed_act", None),
+    ("mlp_act", "model"),
+)
+
+
+def initialize_distributed() -> None:
+    """Bootstrap multi-process JAX when launched under a multi-host runtime.
+
+    Safe to call unconditionally: no-ops when single-process (no coordinator
+    address configured) or when already initialized. Must run before any
+    backend query — even ``jax.process_count()`` initializes backends, after
+    which ``jax.distributed.initialize()`` raises — so the guards here only
+    touch env/config state.
+    """
+    addr = os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
+        "COORDINATOR_ADDRESS"
+    )
+    if not addr:
+        return
+    from jax._src import distributed as _dist
+
+    if _dist.global_state.coordinator_address is not None:
+        return  # already initialized
+    jax.distributed.initialize()
+
+
+def is_coordinator() -> bool:
+    """True on process 0 — gate logging/checkpoint-commit/tracker on this."""
+    return jax.process_index() == 0
+
+
+def make_mesh(
+    data: int = -1,
+    seq: int = 1,
+    model: int = 1,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+    allow_split_physical_axes: bool = False,
+) -> Mesh:
+    """Build a ``(data, seq, model)`` mesh.
+
+    ``data=-1`` absorbs all remaining devices. On multi-slice TPU systems the
+    data axis is laid over DCN (slices) and seq/model over ICI, via
+    ``create_hybrid_device_mesh``; on a single slice or CPU the mesh comes
+    from ``create_device_mesh`` / a plain reshape.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if data == -1:
+        rest = seq * model
+        if n % rest != 0:
+            raise ValueError(f"{n} devices not divisible by seq*model={rest}")
+        data = n // rest
+    shape = (data, seq, model)
+    if int(np.prod(shape)) != n:
+        raise ValueError(f"mesh shape {shape} does not match {n} devices")
+
+    num_slices = len({getattr(d, "slice_index", 0) for d in devices})
+    if num_slices > 1 and data % num_slices == 0:
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            (data // num_slices, seq, model),
+            (num_slices, 1, 1),
+            devices=devices,
+        )
+    else:
+        try:
+            dev_array = mesh_utils.create_device_mesh(
+                shape,
+                devices=devices,
+                allow_split_physical_axes=allow_split_physical_axes,
+            )
+        except (ValueError, AssertionError):
+            # CPU simulation / odd topologies: any assignment is fine.
+            dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, MESH_AXES)
+
+
+@contextmanager
+def logical_rules(rules=DEFAULT_RULES):
+    """Context in which flax `with_logical_constraint` annotations resolve."""
+    with nn.logical_axis_rules(rules):
+        yield
+
+
+def state_shardings(abstract_state: Any, mesh: Mesh, rules=DEFAULT_RULES) -> Any:
+    """Shardings for any pytree mixing flax ``Partitioned`` boxes (annotated
+    weights — and optimizer moments, which inherit the boxes because optax
+    builds them with structure-preserving tree maps) and plain leaves
+    (step counters, norm scales), the latter pinned fully-replicated.
+
+    Each box becomes ONE NamedSharding leaf at the box's position, i.e. the
+    result is a pytree *prefix* of the state — exactly what jit's
+    in/out_shardings accept.
+    """
+    from flax.core import meta
+    from flax.linen import spmd
+
+    def to_sharding(leaf):
+        if isinstance(leaf, meta.AxisMetadata):
+            logical = leaf.get_partition_spec()
+            mesh_spec = spmd.logical_to_mesh_axes(logical, tuple(rules))
+            return NamedSharding(mesh, mesh_spec)
+        return NamedSharding(mesh, PartitionSpec())
+
+    return jax.tree.map(
+        to_sharding,
+        abstract_state,
+        is_leaf=lambda x: isinstance(x, meta.AxisMetadata),
+    )
+
+
+def param_shardings(
+    abstract_variables: Any, mesh: Mesh, rules=DEFAULT_RULES
+) -> Any:
+    """Map a flax variables pytree (with logical-axis metadata, e.g. from
+    ``jax.eval_shape(model.init, ...)``) to a pytree of `NamedSharding`s."""
+    return state_shardings(abstract_variables, mesh, rules)
+
+
+def batch_sharding(mesh: Mesh, *, accum_axis: bool = False) -> NamedSharding:
+    """Sharding for an integer token batch: (mb, L) or (accum, mb, L),
+    micro-batch dim over ``data``, sequence replicated (the attention wants
+    whole windows; sequence parallelism shards activations, not input ids)."""
+    if accum_axis:
+        return NamedSharding(mesh, PartitionSpec(None, "data", None))
+    return NamedSharding(mesh, PartitionSpec("data", None))
+
+
+def put_batch(batch, mesh: Mesh, *, accum_axis: bool = False):
+    """Place a host batch onto the mesh. Single-process: a device_put with
+    the batch sharding. Multi-host: each process holds only its shard of the
+    global batch (the data iterator dealt records per-process, see
+    data/dataset.py) and `make_array_from_process_local_data` assembles the
+    logical global array without any cross-host transfer."""
+    sharding = batch_sharding(mesh, accum_axis=accum_axis)
+    if jax.process_count() == 1:
+        return jax.device_put(batch, sharding)
+    return jax.make_array_from_process_local_data(sharding, batch)

@@ -1,0 +1,105 @@
+"""Autoregressive sampling: top-k Gumbel-max decode.
+
+Behavioral parity (/root/reference/progen_transformer/utils.py:97-135):
+  * fixed-shape (length,) sequence buffer, scatter-write of each new token;
+  * Gumbel-max top-k: ``mask = logits > min(top_k(logits))``, non-top-k
+    logits AND their noise zeroed (utils.py:97-104) — quirk preserved: the
+    zeroed entries still compete in the argmax at value 0, so a token
+    outside the top-k can win if every top-k ``logit + gumbel`` lands below
+    0. Kept for parity and because it is vanishingly rare with trained
+    logits (document-don't-silently-fix);
+  * ``add_bos`` shifts the prime right by one (utils.py:110-111);
+  * post-hoc truncation: everything after the SECOND zero is zeroed (BOS is
+    the first; the emitted EOS is the second, utils.py:132-133).
+
+TPU-first design: the ENTIRE decode is one jitted ``lax.fori_loop`` — the
+sequence buffer, params, and RNG key stay device-resident for the whole
+generation. The reference instead runs a Python loop dispatching one jitted
+full forward per token from the host (utils.py:115-129), paying a dispatch +
+transfer round-trip per token. Still O(length) full forwards like the
+reference; the incremental KV-cache path is tracked separately.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+EPS = 1e-20  # reference log() epsilon, utils.py:20
+
+
+def gumbel_noise(key: jax.Array, shape) -> jnp.ndarray:
+    u = jax.random.uniform(key, shape, minval=0.0, maxval=1.0)
+    return -jnp.log(-jnp.log(u + EPS) + EPS)
+
+
+def select_top_k(logits: jnp.ndarray, k: int):
+    """(mask, masked_logits): keep entries strictly above the k-th value's
+    minimum, zero the rest (utils.py:97-100)."""
+    values, _ = jax.lax.top_k(logits, k)
+    mask = logits > values.min(axis=-1, keepdims=True)
+    return mask, jnp.where(mask, logits, 0.0)
+
+
+@functools.partial(jax.jit, static_argnames=("model", "length", "top_k"))
+def _decode(
+    model,
+    params,
+    key: jax.Array,
+    seq: jnp.ndarray,
+    start_pos: jnp.ndarray,
+    length: int,
+    top_k: Optional[int],
+):
+    """seq: (length,) int32 buffer primed up to start_pos. One fori_loop
+    iteration = one full forward + one Gumbel top-k draw + one scatter."""
+
+    def body(pos, carry):
+        seq, key = carry
+        logits = model.apply({"params": params}, seq[None])[0]
+        logit = jax.lax.dynamic_index_in_dim(
+            logits, pos - 1, axis=0, keepdims=False
+        )
+        key, sub = jax.random.split(key)
+        noise = gumbel_noise(sub, logit.shape)
+        if top_k is not None:
+            mask, logit = select_top_k(logit, top_k)
+            noise = noise * mask
+        sampled = jnp.argmax(logit + noise, axis=-1).astype(seq.dtype)
+        # write only if pos >= start_pos (loop starts there, always true;
+        # kept branch-free)
+        seq = jax.lax.dynamic_update_index_in_dim(seq, sampled, pos, axis=0)
+        return seq, key
+
+    seq, _ = jax.lax.fori_loop(start_pos, length, body, (seq, key))
+    # zero everything after the second zero token (utils.py:132-133)
+    after_eos = jnp.cumsum(seq == 0, axis=-1) > 1
+    return seq * (~after_eos)
+
+
+def sample(
+    key: jax.Array,
+    model,
+    params,
+    prime: jnp.ndarray,
+    length: int,
+    top_k: Optional[int] = 25,
+    add_bos: bool = False,
+) -> jnp.ndarray:
+    """Generate a (length,) token sequence continuing ``prime`` (1-D ints).
+
+    Defaults mirror sample.py:70 (top_k=25; train-loop sampling uses
+    add_bos=True, train.py:218).
+    """
+    prime = jnp.asarray(prime, jnp.int32)
+    start = prime.shape[-1] + (1 if add_bos else 0)
+    if start >= length:
+        raise ValueError(f"prime length {start} must be < length {length}")
+    pad = (1, length - prime.shape[-1] - 1) if add_bos else (0, length - prime.shape[-1])
+    seq = jnp.pad(prime, pad)
+    return _decode(
+        model, params, key, seq, jnp.asarray(start), length, top_k
+    )

@@ -1,0 +1,13 @@
+from progen_tpu.training.loss import cross_entropy, masked_mean
+from progen_tpu.training.optimizer import make_optimizer
+from progen_tpu.training.state import TrainState
+from progen_tpu.training.step import make_eval_step, make_train_step
+
+__all__ = [
+    "cross_entropy",
+    "masked_mean",
+    "make_optimizer",
+    "TrainState",
+    "make_eval_step",
+    "make_train_step",
+]

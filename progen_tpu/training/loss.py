@@ -1,0 +1,51 @@
+"""EOS-masked cross entropy.
+
+Semantics match /root/reference/progen_transformer/utils.py:45-59 exactly:
+the padding token 0 doubles as end-of-string, so the loss mask keeps every
+non-pad position *plus the first pad position* (``(~mask).cumsum(-1) == 1``)
+— the model is trained to emit EOS, and nothing after it. The reduction is a
+per-sequence masked mean followed by a plain mean over the batch
+(utils.py:63-77: vmap over sequences, then ``np.mean``), NOT a global
+masked mean — sequences with few valid tokens weigh the same as full ones.
+
+TPU deltas: batch-first, computed in float32 regardless of logits input
+dtype (the model already returns f32 logits), single fused log-softmax.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def masked_mean(t: jnp.ndarray, mask: jnp.ndarray, axis=None) -> jnp.ndarray:
+    """Mean of ``t`` over positions where ``mask`` is set (utils.py:42-43)."""
+    mask = mask.astype(t.dtype)
+    return (t * mask).sum(axis=axis) / mask.sum(axis=axis)
+
+
+def eos_loss_mask(targets: jnp.ndarray, ignore_index: int = 0) -> jnp.ndarray:
+    """Boolean mask of positions that contribute to the loss: non-pad tokens
+    plus the first pad position (the EOS the model must learn to emit)."""
+    nonpad = targets != ignore_index
+    first_pad = (~nonpad).cumsum(axis=-1) == 1
+    return nonpad | first_pad
+
+
+def cross_entropy(
+    logits: jnp.ndarray,
+    targets: jnp.ndarray,
+    *,
+    ignore_index: int = 0,
+) -> jnp.ndarray:
+    """logits: (..., n, vocab); targets: (..., n) ints.
+
+    Returns per-sequence losses of shape ``logits.shape[:-2]`` — a masked
+    mean over each sequence's kept positions. Callers average over the batch
+    (see make_train_step), matching the reference's vmap-then-mean.
+    """
+    logits = logits.astype(jnp.float32)
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
+    mask = eos_loss_mask(targets, ignore_index)
+    return masked_mean(nll, mask, axis=-1)

@@ -1,0 +1,40 @@
+"""Optimizer: global-norm clip + weight-decay-masked AdamW.
+
+Reference recipe (/root/reference/train.py:113-121):
+``chain(clip_by_global_norm(0.5), adamw(2e-4, wd=1e-3, mask=ndim>1),
+apply_every(grad_accum_every))`` — weight decay skipped for norms/biases
+(any rank-<2 leaf).
+
+Deliberate TPU delta: the reference's ``optax.apply_every`` accumulates the
+*transformed updates* host-side, calling the whole chain every micro-step.
+Here gradient accumulation instead happens inside the jitted train step via
+``lax.scan`` over micro-batches (see step.py) — gradients are averaged
+*before* clipping, so clipping acts on the effective batch gradient (the
+mathematically standard form) and the optimizer runs once per outer step.
+"""
+
+from __future__ import annotations
+
+import jax
+import optax
+
+
+def weight_decay_mask(params) -> object:
+    """True for leaves that receive weight decay: rank >= 2 (all projection /
+    embedding matrices; norms scales and biases excluded — train.py:115)."""
+    return jax.tree.map(lambda p: p.ndim > 1, params)
+
+
+def make_optimizer(
+    learning_rate: float = 2e-4,
+    weight_decay: float = 1e-3,
+    max_grad_norm: float = 0.5,
+) -> optax.GradientTransformation:
+    return optax.chain(
+        optax.clip_by_global_norm(max_grad_norm),
+        optax.adamw(
+            learning_rate,
+            weight_decay=weight_decay,
+            mask=weight_decay_mask,
+        ),
+    )

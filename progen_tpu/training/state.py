@@ -1,0 +1,37 @@
+"""Sharded training state.
+
+A pure-array pytree (no function leaves) so it can be (a) donated through the
+jitted train step, (b) sharded leaf-by-leaf over the mesh, and (c) handed
+directly to the checkpointer. The model's apply fn and the optimizer live in
+closures (step.py), not here — the reference keeps params/optim_state as
+loose variables on the host between steps (/root/reference/train.py:185-190,
+re-broadcast under pmap every call); keeping them device-resident in one
+donated pytree removes that per-step host round-trip.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+class TrainState(struct.PyTreeNode):
+    step: jnp.ndarray  # i32 scalar — optimizer steps taken
+    params: Any  # flax params pytree (with logical-axis metadata boxes)
+    opt_state: Any
+
+    @classmethod
+    def create(cls, params, optimizer) -> "TrainState":
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=optimizer.init(params),
+        )
+
+    def num_params(self) -> int:
+        return sum(
+            int(jnp.size(x)) for x in jax.tree.leaves(self.params)
+        )

@@ -1,0 +1,185 @@
+"""The fused, donated, mesh-sharded train step.
+
+Capability target (/root/reference/progen_transformer/utils.py:61-93 +
+/root/reference/train.py:113-121,179-222): per-sequence EOS-masked cross
+entropy averaged over the batch, gradient accumulation, global-norm clip,
+masked AdamW.
+
+TPU-first design, where the reference differs:
+  * ONE jitted step per optimizer update: `lax.scan` over micro-batches
+    accumulates gradients on-device (the reference runs a separate
+    jit+host-optimizer round trip per micro-step, train.py:185-190).
+  * The TrainState is donated — params/opt-state never leave the device, and
+    under pjit the GSPMD partitioner inserts the gradient reductions over
+    the mesh's ``data`` axis (the reference relies on the implicit transpose
+    of pmap's broadcast, utils.py:70-91).
+  * Batch layout is (grad_accum, micro_batch, seq_len+1), micro-batch dim
+    sharded over ``data``; the [:-1]/[1:] input/label shift happens inside
+    the step (utils.py:63).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+
+from progen_tpu.parallel.partition import (
+    DEFAULT_RULES,
+    batch_sharding,
+    state_shardings,
+)
+from progen_tpu.training.loss import cross_entropy
+from progen_tpu.training.state import TrainState
+
+Metrics = dict
+
+
+def batch_loss(model, params, data: jnp.ndarray) -> jnp.ndarray:
+    """data: (mb, seq_len+1) int tokens. Mean over per-sequence masked CE
+    (matches vmap-then-mean of utils.py:67,77)."""
+    ids, labels = data[..., :-1], data[..., 1:]
+    logits = model.apply({"params": params}, ids)
+    return cross_entropy(logits, labels).mean()
+
+
+def make_train_step(
+    model, optimizer, rules=DEFAULT_RULES
+) -> Callable[[TrainState, jnp.ndarray], Tuple[TrainState, Metrics]]:
+    """Returns train_step(state, batch) -> (state, metrics).
+
+    batch: (grad_accum, micro_batch, seq_len+1) ints. Gradients are averaged
+    over the accumulation axis *before* clipping (see optimizer.py for why
+    this deliberately differs from the reference's apply_every placement).
+    """
+
+    def train_step(state: TrainState, batch: jnp.ndarray):
+        with nn.logical_axis_rules(rules):
+            grad_fn = jax.value_and_grad(
+                lambda p, mb: batch_loss(model, p, mb)
+            )
+
+            def micro(grads_acc, mb):
+                loss, grads = grad_fn(state.params, mb)
+                grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
+                return grads_acc, loss
+
+            zero_grads = jax.tree.map(jnp.zeros_like, state.params)
+            grads, losses = jax.lax.scan(micro, zero_grads, batch)
+            grads = jax.tree.map(lambda g: g / batch.shape[0], grads)
+
+            updates, opt_state = optimizer.update(
+                grads, state.opt_state, state.params
+            )
+            params = optax.apply_updates(state.params, updates)
+            new_state = state.replace(
+                step=state.step + 1, params=params, opt_state=opt_state
+            )
+            metrics = {
+                "loss": losses.mean(),
+                "last_micro_loss": losses[-1],
+                "grad_norm": optax.global_norm(grads),
+            }
+            return new_state, metrics
+
+    return train_step
+
+
+def make_eval_step(model, rules=DEFAULT_RULES):
+    """eval_step(state, data(mb, L+1)) -> scalar loss. Unlike the reference
+    (which re-runs the grad fn and discards gradients, train.py:209), this is
+    a forward-only program."""
+
+    def eval_step(state: TrainState, data: jnp.ndarray):
+        with nn.logical_axis_rules(rules):
+            return batch_loss(model, state.params, data)
+
+    return eval_step
+
+
+def _boxed_init_fn(model, optimizer, seq_len):
+    def init_fn(rng):
+        dummy = jnp.zeros((1, seq_len), jnp.int32)
+        variables = model.init(rng, dummy)
+        return TrainState.create(variables["params"], optimizer)
+
+    return init_fn
+
+
+def abstract_train_state(model, optimizer, seq_len: int) -> Tuple[Any, Any]:
+    """(boxed, unboxed) abstract TrainState pytrees. The boxed one carries
+    the flax Partitioned metadata (feed to partition.state_shardings); the
+    unboxed one is the plain-array template matching the live state (feed to
+    checkpoint restore)."""
+    from flax.core import meta
+
+    boxed = jax.eval_shape(
+        _boxed_init_fn(model, optimizer, seq_len), jax.random.PRNGKey(0)
+    )
+    return boxed, meta.unbox(boxed)
+
+
+def init_train_state(
+    model,
+    optimizer,
+    rng: jax.Array,
+    seq_len: int,
+    mesh=None,
+    rules=DEFAULT_RULES,
+) -> Tuple[TrainState, Any]:
+    """Initialize a TrainState of PLAIN arrays (flax Partitioned boxes are
+    stripped — sharding metadata lives in the returned shardings tree, not
+    in the state, so optax/orbax/donation see ordinary pytrees). With a
+    mesh, every leaf is created directly into its NamedSharding via jit
+    out_shardings — the full model never materializes on one host.
+
+    Returns (state, shardings); shardings is None without a mesh.
+    """
+    from flax.core import meta
+
+    init_fn = _boxed_init_fn(model, optimizer, seq_len)
+
+    def init_unboxed(rng):
+        return meta.unbox(init_fn(rng))
+
+    if mesh is None:
+        return jax.jit(init_unboxed)(rng), None
+
+    abstract = jax.eval_shape(init_fn, rng)
+    shardings = state_shardings(abstract, mesh, rules)
+    with mesh:
+        state = jax.jit(init_unboxed, out_shardings=shardings)(rng)
+    return state, shardings
+
+
+def compile_train_step(
+    model,
+    optimizer,
+    state: TrainState,
+    shardings,
+    mesh,
+    rules=DEFAULT_RULES,
+):
+    """jit the train step with explicit state/batch shardings and a donated
+    state argument. Returns the compiled-on-first-call step fn; call it
+    inside ``with mesh`` (or rely on the shardings carrying the mesh)."""
+    step = make_train_step(model, optimizer, rules)
+    return jax.jit(
+        step,
+        in_shardings=(shardings, batch_sharding(mesh, accum_axis=True)),
+        out_shardings=(shardings, None),
+        donate_argnums=(0,),
+    )
+
+
+def compile_eval_step(model, shardings, mesh, rules=DEFAULT_RULES):
+    """jit the forward-only eval step with the same state shardings."""
+    step = make_eval_step(model, rules)
+    return jax.jit(
+        step,
+        in_shardings=(shardings, batch_sharding(mesh)),
+        out_shardings=None,
+    )

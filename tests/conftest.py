@@ -1,15 +1,33 @@
 """Test configuration: run everything on a virtual 8-device CPU mesh.
 
 Multi-device tests run without TPU hardware via
---xla_force_host_platform_device_count (SURVEY.md section 4). Must run before
-jax initializes its backends, hence module-level in conftest.
+--xla_force_host_platform_device_count (SURVEY.md section 4).
+
+The driver environment registers the `axon` TPU PJRT backend in EVERY
+python process via sitecustomize; initializing it dials the single-chip
+relay, which serializes the unit suite behind (or deadlocks with) any other
+process holding the chip grant. Registration is per-process state in
+jax's xla_bridge, so it is unregistered here BEFORE any backend
+initializes. Benchmarks (bench.py) keep the plugin and run on the real
+chip; the unit suite is hermetic CPU.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# env var alone is insufficient: sitecustomize imports jax at interpreter
+# startup, freezing jax_platforms from the then-current env
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+import jax._src.xla_bridge as _xb  # noqa: E402
+
+assert not _xb.backends_are_initialized(), (
+    "conftest must run before any jax backend initializes"
+)
+jax.config.update("jax_platforms", "cpu")
+_xb._backend_factories.pop("axon", None)  # never dial the chip relay

@@ -1,0 +1,127 @@
+"""Checkpoint tests: package schema, retention, and resume-equivalence
+(train N, checkpoint, resume, train N == train 2N) — SURVEY §4."""
+
+import jax
+import numpy as np
+import pytest
+
+from progen_tpu.checkpoint import Package, get_checkpoint_fns
+from progen_tpu.config import ProGenConfig
+from progen_tpu.models.progen import ProGen
+from progen_tpu.training.optimizer import make_optimizer
+from progen_tpu.training.step import (
+    abstract_train_state,
+    init_train_state,
+    make_train_step,
+)
+
+TINY = ProGenConfig(
+    num_tokens=32,
+    dim=32,
+    seq_len=32,
+    depth=2,
+    window_size=8,
+    global_mlp_depth=1,
+    heads=2,
+    dim_head=16,
+    ff_mult=2,
+    dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    model = ProGen(TINY)
+    optimizer = make_optimizer(learning_rate=1e-3)
+    state, _ = init_train_state(
+        model, optimizer, jax.random.PRNGKey(0), TINY.seq_len
+    )
+    step = jax.jit(make_train_step(model, optimizer))
+    batch = jax.random.randint(
+        jax.random.PRNGKey(5), (1, 2, TINY.seq_len + 1), 0, 32
+    )
+    return model, optimizer, state, step, batch
+
+
+class TestCheckpointFns:
+    def test_empty_dir_returns_none(self, tmp_path):
+        _, get_last, _ = get_checkpoint_fns(str(tmp_path / "ckpts"))
+        assert get_last() is None
+
+    def test_round_trip_package(self, setup, tmp_path):
+        model, optimizer, state, _, _ = setup
+        reset, get_last, save = get_checkpoint_fns(str(tmp_path / "ckpts"))
+        save(
+            Package(
+                next_seq_index=123,
+                state=state,
+                model_config=TINY.to_dict(),
+                run_id="run-abc",
+            )
+        )
+        _, abstract = abstract_train_state(model, optimizer, TINY.seq_len)
+        pkg = get_last(abstract)
+        assert pkg.next_seq_index == 123
+        assert pkg.run_id == "run-abc"
+        assert pkg.model_config["dim"] == TINY.dim
+        for a, b in zip(jax.tree.leaves(pkg.state), jax.tree.leaves(state)):
+            np.testing.assert_array_equal(a, b)
+
+    def test_config_reconstructs_model(self, setup, tmp_path):
+        """sample.py parity: the model is rebuilt purely from the checkpoint
+        (sample.py:46-47); checkpoint config overrides the TOML on resume
+        (train.py:99-100)."""
+        _, _, state, _, _ = setup
+        _, get_last, save = get_checkpoint_fns(str(tmp_path / "c"))
+        save(Package(0, state, TINY.to_dict(), None))
+        pkg = get_last()
+        rebuilt = ProGenConfig.from_dict(pkg.model_config)
+        assert rebuilt == TINY
+
+    def test_retention(self, setup, tmp_path):
+        """Rapid saves (same wall-second) still get strictly increasing
+        names, and only keep_last_n survive."""
+        _, _, state, _, _ = setup
+        _, get_last, save = get_checkpoint_fns(
+            str(tmp_path / "c"), keep_last_n=2
+        )
+        for i in range(4):
+            save(Package(i, state, {}, None))
+
+        kept = sorted(p.name for p in (tmp_path / "c").iterdir())
+        assert len(kept) == 2
+        assert get_last().next_seq_index == 3
+
+    def test_reset_wipes(self, setup, tmp_path):
+        _, _, state, _, _ = setup
+        reset, get_last, save = get_checkpoint_fns(str(tmp_path / "c"))
+        save(Package(7, state, {}, None))
+        reset()
+        assert get_last() is None
+
+
+class TestResumeEquivalence:
+    def test_train_resume_equals_straight_run(self, setup, tmp_path):
+        model, optimizer, state0, step, batch = setup
+
+        # straight: 4 steps
+        s = state0
+        for _ in range(4):
+            s, _ = step(s, batch)
+        straight = s
+
+        # interrupted: 2 steps, save, restore sharded-abstract, 2 more
+        s = state0
+        for _ in range(2):
+            s, _ = step(s, batch)
+        _, get_last, save = get_checkpoint_fns(str(tmp_path / "c"))
+        save(Package(2, s, TINY.to_dict(), None))
+
+        _, abstract = abstract_train_state(model, optimizer, TINY.seq_len)
+        pkg = get_last(abstract)
+        s = pkg.state
+        for _ in range(2):
+            s, _ = step(s, batch)
+
+        for a, b in zip(jax.tree.leaves(straight), jax.tree.leaves(s)):
+            np.testing.assert_allclose(a, b, atol=1e-6)

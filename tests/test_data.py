@@ -1,0 +1,251 @@
+"""Data-layer tests: tokenizer, from-scratch TFRecord codec (incl. wire
+compatibility with TensorFlow), resumable/sharded iterator, FASTA ETL."""
+
+import gzip
+
+import numpy as np
+import pytest
+
+from progen_tpu.data.dataset import (
+    collate,
+    count_from_filename,
+    iterator_from_tfrecords_folder,
+)
+from progen_tpu.data.fasta import (
+    annotations_from_description,
+    generate_data,
+    parse_fasta,
+    sequence_strings,
+)
+from progen_tpu.data.tfrecord import (
+    decode_example,
+    encode_example,
+    read_tfrecords,
+    tfrecord_writer,
+)
+from progen_tpu.data.tokenizer import decode_tokens, encode_tokens
+
+
+class TestTokenizer:
+    def test_round_trip(self):
+        s = "[tax=Mammalia] # MGHK"
+        assert decode_tokens(encode_tokens(s)) == s
+
+    def test_offset_is_one(self):
+        np.testing.assert_array_equal(encode_tokens("A"), [ord("A") + 1])
+
+    def test_pad_decodes_to_empty(self):
+        assert decode_tokens(np.array([0, ord("M") + 1, 0, 0])) == "M"
+
+
+class TestTFRecordCodec:
+    def test_example_round_trip(self):
+        payload = encode_example(b"MGHKLV")
+        assert decode_example(payload) == b"MGHKLV"
+
+    def test_file_round_trip(self, tmp_path):
+        path = str(tmp_path / "0.3.train.tfrecord.gz")
+        seqs = [b"# MGH", b"[tax=X] # KLV", b"# " + b"A" * 500]
+        with tfrecord_writer(path) as write:
+            for s in seqs:
+                write(s)
+        assert list(read_tfrecords(path)) == seqs
+
+    def test_tf_reads_our_files(self, tmp_path):
+        tf = pytest.importorskip("tensorflow")
+        path = str(tmp_path / "0.2.train.tfrecord.gz")
+        with tfrecord_writer(path) as write:
+            write(b"# MGHK")
+            write(b"# LVAA")
+        ds = tf.data.TFRecordDataset([path], compression_type="GZIP")
+        got = []
+        for raw in ds:
+            ex = tf.io.parse_single_example(
+                raw, {"seq": tf.io.FixedLenFeature([], tf.string)}
+            )
+            got.append(ex["seq"].numpy())
+        assert got == [b"# MGHK", b"# LVAA"]
+
+    def test_we_read_tf_files(self, tmp_path):
+        tf = pytest.importorskip("tensorflow")
+        path = str(tmp_path / "0.2.train.tfrecord.gz")
+        opts = tf.io.TFRecordOptions(compression_type="GZIP")
+        with tf.io.TFRecordWriter(path, opts) as w:
+            for s in (b"# MGHK", b"# LVAA"):
+                ex = tf.train.Example(
+                    features=tf.train.Features(
+                        feature={
+                            "seq": tf.train.Feature(
+                                bytes_list=tf.train.BytesList(value=[s])
+                            )
+                        }
+                    )
+                )
+                w.write(ex.SerializeToString())
+        assert list(read_tfrecords(path)) == [b"# MGHK", b"# LVAA"]
+
+    def test_corruption_detected(self, tmp_path):
+        path = str(tmp_path / "0.1.train.tfrecord.gz")
+        with tfrecord_writer(path) as write:
+            write(b"# MGHK")
+        raw = gzip.open(path, "rb").read()
+        bad = raw[:15] + bytes([raw[15] ^ 0xFF]) + raw[16:]
+        bad_path = str(tmp_path / "bad.gz")
+        with gzip.open(bad_path, "wb") as f:
+            f.write(bad)
+        with pytest.raises((ValueError, EOFError)):
+            list(read_tfrecords(bad_path))
+
+
+class TestCollate:
+    def test_truncate_offset_pad_bos(self):
+        out = collate([b"ABCDEFGH", b"AB"], seq_len=4)
+        assert out.shape == (2, 5)
+        assert out[0, 0] == 0  # BOS
+        np.testing.assert_array_equal(
+            out[0, 1:], np.frombuffer(b"ABCD", np.uint8).astype(np.int32) + 1
+        )
+        np.testing.assert_array_equal(out[1, 3:], [0, 0])  # right pad
+
+
+def _write_shards(tmp_path, n_files=3, per_file=4):
+    seqs = []
+    for i in range(n_files):
+        path = str(tmp_path / f"{i}.{per_file}.train.tfrecord.gz")
+        with tfrecord_writer(path) as write:
+            for j in range(per_file):
+                s = f"# SEQ{i}_{j}".encode()
+                write(s)
+                seqs.append(s)
+    return seqs
+
+
+class TestIterator:
+    def test_count_contract(self, tmp_path):
+        _write_shards(tmp_path)
+        num, _ = iterator_from_tfrecords_folder(str(tmp_path))
+        assert num == 12
+        assert count_from_filename("7.12345.valid.tfrecord.gz") == 12345
+        with pytest.raises(ValueError):
+            count_from_filename("nonsense.gz")
+
+    def test_order_and_batching(self, tmp_path):
+        seqs = _write_shards(tmp_path)
+        _, iter_fn = iterator_from_tfrecords_folder(str(tmp_path))
+        batches = list(iter_fn(seq_len=16, batch_size=4))
+        assert len(batches) == 3
+        flat = [decode_tokens(row) for b in batches for row in b]
+        assert flat == [s.decode() for s in seqs]
+
+    def test_skip_resume(self, tmp_path):
+        seqs = _write_shards(tmp_path)
+        _, iter_fn = iterator_from_tfrecords_folder(str(tmp_path))
+        rows = [r for b in iter_fn(seq_len=16, batch_size=4, skip=5) for r in b]
+        assert decode_tokens(rows[0]) == seqs[5].decode()
+        assert len(rows) == 7
+
+    def test_process_sharding_partitions_stream(self, tmp_path):
+        seqs = _write_shards(tmp_path)
+        _, iter_fn = iterator_from_tfrecords_folder(str(tmp_path))
+        per_proc = [
+            [
+                decode_tokens(r)
+                for b in iter_fn(
+                    seq_len=16, batch_size=4, process_index=p, process_count=2
+                )
+                for r in b
+            ]
+            for p in range(2)
+        ]
+        # each global batch of 4 = 2 rows per process; interleaved union
+        # reconstructs the global stream
+        assert sorted(per_proc[0] + per_proc[1]) == sorted(
+            s.decode() for s in seqs
+        )
+        assert per_proc[0] == [s.decode() for s in seqs[0::2]]
+
+    def test_loop_repeats(self, tmp_path):
+        _write_shards(tmp_path, n_files=1, per_file=2)
+        _, iter_fn = iterator_from_tfrecords_folder(str(tmp_path))
+        it = iter_fn(seq_len=8, batch_size=2, loop=True)
+        b1, b2 = next(it), next(it)
+        np.testing.assert_array_equal(b1, b2)
+
+
+FASTA = """>UniRef50_A0A009 Uncharacterized protein n=1 Tax=Acinetobacter TaxID=1310605 RepID=X
+MGHKLV
+AATT
+>UniRef50_B0B010 Another n=2 Tax=Homo sapiens TaxID=9606 RepID=Y
+MKV
+>UniRef50_C0C011 No taxonomy here
+MMMM
+"""
+
+
+class TestFastaETL:
+    def test_parse(self, tmp_path):
+        p = tmp_path / "toy.fasta"
+        p.write_text(FASTA)
+        recs = list(parse_fasta(str(p)))
+        assert len(recs) == 3
+        assert recs[0][1] == "MGHKLVAATT"
+        assert recs[1][0].startswith("UniRef50_B0B010")
+
+    def test_annotation_regex_trailing_context(self):
+        # the reference regex requires a following key, and greedily eats
+        # spaces inside the taxonomy name (generate_data.py:37)
+        d = "Uncharacterized n=1 Tax=Homo sapiens TaxID=9606 RepID=X"
+        assert annotations_from_description(d) == {"tax": "Homo sapiens"}
+        assert annotations_from_description("no tax field") == {}
+
+    def test_sequence_strings_always_unannotated(self):
+        import random
+
+        rng = random.Random(0)
+        out = sequence_strings(
+            "x Tax=Acinetobacter TaxID=13 RepID=Y",
+            "MGHK",
+            prob_invert_seq_annotation=0.0,
+            sort_annotations=True,
+            rng=rng,
+        )
+        assert out == [b"[tax=Acinetobacter] # MGHK", b"# MGHK"]
+
+    def test_invert_probability_one_swaps(self):
+        import random
+
+        out = sequence_strings(
+            "x Tax=Acinetobacter TaxID=13 RepID=Y",
+            "MGHK",
+            prob_invert_seq_annotation=1.0,
+            sort_annotations=True,
+            rng=random.Random(0),
+        )
+        assert out[0] == b"MGHK # [tax=Acinetobacter]"
+
+    def test_end_to_end(self, tmp_path):
+        p = tmp_path / "toy.fasta"
+        p.write_text(FASTA)
+        cfg = {
+            "read_from": str(p),
+            "write_to": str(tmp_path / "out"),
+            "num_samples": 10,
+            "max_seq_len": 100,
+            "prob_invert_seq_annotation": 0.5,
+            "fraction_valid_data": 0.25,
+            "num_sequences_per_file": 2,
+            "sort_annotations": True,
+        }
+        written = generate_data(cfg, seed=0)
+        # 3 records, 2 with annotations -> 5 strings; 2 valid, 3 train
+        num_train, it = iterator_from_tfrecords_folder(str(tmp_path / "out"))
+        num_valid, _ = iterator_from_tfrecords_folder(
+            str(tmp_path / "out"), "valid"
+        )
+        assert num_train + num_valid == 5
+        assert num_valid == 2
+        rows = [r for b in it(seq_len=64, batch_size=2) for r in b]
+        assert len(rows) == num_train
+        for r in rows:
+            text = decode_tokens(r)
+            assert "#" in text
