@@ -33,13 +33,14 @@ def main(seed, checkpoint_path, prime, top_k):
     from progen_tpu.sampling import sample
 
     _, get_last, _ = get_checkpoint_fns(checkpoint_path)
-    pkg = get_last()
+    # params-only restore: sampling never needs the optimizer moments
+    pkg = get_last.restore_params()
     if pkg is None:
         sys.exit(f"no checkpoints found at {checkpoint_path}")
 
     config = ProGenConfig.from_dict(pkg.model_config)
     model = ProGen(config)
-    params = pkg.state["params"] if isinstance(pkg.state, dict) else pkg.state.params
+    params = pkg.state
 
     num_params = sum(int(np.size(x)) for x in jax.tree.leaves(params))
     print(f"params: {num_params:,}")

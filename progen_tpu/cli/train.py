@@ -213,16 +213,20 @@ def main(
 
     seq_indices = range(start_seq_index, num_train, effective_batch)
     steps_done = 0
+    # metric step continues across resumes (state.step is checkpointed);
+    # a restarted loop must not rewind the tracker's step axis
+    start_step = int(jax.device_get(state.step))
     with mesh:
         for i, seq_index in enumerate(tqdm.tqdm(seq_indices, mininterval=10)):
             if num_steps and steps_done >= num_steps:
                 break
             state, metrics = train_step(state, next_super_batch())
             steps_done += 1
+            global_step = start_step + steps_done
             loss = float(metrics["last_micro_loss"])
             if is_coordinator():
                 print(f"loss: {loss:.4f}")
-            tracker.log({"loss": loss}, step=i)
+            tracker.log({"loss": loss}, step=global_step)
 
             next_seq_index = seq_index + effective_batch
             if i % checkpoint_every == 0:
@@ -242,10 +246,16 @@ def main(
                 )
                 if is_coordinator():
                     print(f"valid_loss: {vloss:.4f}")
-                tracker.log({"valid_loss": vloss}, step=i)
+                tracker.log({"valid_loss": vloss}, step=global_step)
             if i % sample_every == 0:
                 valid_batch = np.asarray(next(valid_ds))
                 prime = valid_batch[0, 1 : prime_length + 1]  # skip BOS col
+                if jax.process_count() > 1:
+                    # every process must feed the IDENTICAL prime into the
+                    # jitted decode over globally-sharded params
+                    from jax.experimental import multihost_utils
+
+                    prime = multihost_utils.broadcast_one_to_all(prime)
                 sampled = sample_tokens(
                     jax.random.fold_in(sample_rng, i),
                     model,
@@ -262,7 +272,7 @@ def main(
                 tracker.log_html(
                     "samples",
                     render_sample_html(prime_str, sampled_str),
-                    step=i,
+                    step=global_step,
                 )
 
     # final checkpoint so short runs (e.g. --num_steps) always persist;

@@ -45,6 +45,10 @@ def _gcs_glob(folder: str, data_type: str) -> List[str]:
     from google.cloud import storage  # deferred; optional dependency
 
     bucket_name, _, prefix = folder[len("gs://") :].partition("/")
+    # GCS prefix match is a raw string prefix: anchor to the directory so
+    # gs://b/run1 does not swallow gs://b/run10/ or gs://b/run1_old/
+    if prefix and not prefix.endswith("/"):
+        prefix += "/"
     client = storage.Client()
     names = [
         f"gs://{bucket_name}/{b.name}"

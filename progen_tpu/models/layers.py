@@ -93,7 +93,8 @@ class LocalAttentionBlock(nn.Module):
         if c.use_pallas_attn:
             from progen_tpu.ops.pallas_attention import pallas_local_attention
 
-            out = pallas_local_attention(q, k, v, window_size=w)
+            # positional args: custom_vjp nondiff_argnums are positional
+            out = pallas_local_attention(q, k, v, w)
         else:
             out = local_attention(q, k, v, window_size=w)
 

@@ -1,0 +1,234 @@
+"""Pallas TPU kernel for windowed causal local attention (fwd + custom VJP).
+
+Same math as progen_tpu/ops/attention.py:local_attention (the XLA golden,
+itself bit-parity with /root/reference/progen_transformer/progen.py:88-101,
+including the window-0 zero-key softmax dilution). Design:
+
+  * block = one attention window (w queries), halo = the previous window:
+    grid (batch*heads, n/w); each program loads q[i] (w, d) and k/v for
+    windows i-1 and i (the halo is expressed as a second BlockSpec over the
+    same array with a shifted index map — no data duplication in HBM);
+  * window 0's "previous window" is zeroed in-register (multiply by
+    ``i > 0``), reproducing the reference's zero-padding;
+  * scores/softmax accumulate in f32 whatever the input dtype (bf16-safe);
+  * backward is flash-style: recompute the (w, 2w) probabilities from the
+    saved q/k/v instead of storing them; each program emits dq for its
+    window and d(k2)/d(v2) for its [prev|cur] halo pair, and the halo
+    overlap is resolved OUTSIDE the kernel by one shifted add (window i's
+    dk gets the "current" half of program i plus the "previous" half of
+    program i+1). The discarded first-half at program 0 is exactly the
+    gradient of the phantom zero keys.
+
+VMEM at w=512, d=64, f32: q/k2/v2 ~0.4 MB + probs (w, 2w) 2 MB — fits
+comfortably; at w=256 everything halves.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from progen_tpu.ops.attention import ATTN_MASK_VALUE
+
+
+def _window_mask(w: int) -> jnp.ndarray:
+    i = jax.lax.broadcasted_iota(jnp.int32, (w, 2 * w), 0)
+    j = jax.lax.broadcasted_iota(jnp.int32, (w, 2 * w), 1)
+    return j <= i + w
+
+
+def _halo_kv(kp_ref, kc_ref, vp_ref, vc_ref, dtype):
+    """Concatenate [prev | cur] k/v, zeroing the prev halo for window 0."""
+    not_first = (pl.program_id(1) > 0).astype(dtype)
+    k2 = jnp.concatenate([kp_ref[0] * not_first, kc_ref[0]], axis=0)
+    v2 = jnp.concatenate([vp_ref[0] * not_first, vc_ref[0]], axis=0)
+    return k2, v2
+
+
+def _fwd_kernel(q_ref, kp_ref, kc_ref, vp_ref, vc_ref, o_ref, *, scale):
+    w = q_ref.shape[1]
+    q = q_ref[0].astype(jnp.float32)
+    k2, v2 = _halo_kv(kp_ref, kc_ref, vp_ref, vc_ref, jnp.float32)
+
+    s = jax.lax.dot_general(
+        q, k2,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    s = jnp.where(_window_mask(w), s, ATTN_MASK_VALUE)
+    s = s - s.max(axis=-1, keepdims=True)
+    e = jnp.exp(s)
+    p = e / e.sum(axis=-1, keepdims=True)
+
+    o = jnp.dot(p, v2, preferred_element_type=jnp.float32)
+    o_ref[0] = o.astype(o_ref.dtype)
+
+
+def _bwd_kernel(
+    q_ref, kp_ref, kc_ref, vp_ref, vc_ref, do_ref,
+    dq_ref, dk2_ref, dv2_ref, *, scale,
+):
+    w = q_ref.shape[1]
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    k2, v2 = _halo_kv(kp_ref, kc_ref, vp_ref, vc_ref, jnp.float32)
+
+    s = jax.lax.dot_general(
+        q, k2,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    s = jnp.where(_window_mask(w), s, ATTN_MASK_VALUE)
+    s = s - s.max(axis=-1, keepdims=True)
+    e = jnp.exp(s)
+    p = e / e.sum(axis=-1, keepdims=True)  # (w, 2w)
+
+    dp = jax.lax.dot_general(  # dO @ v2^T -> (w, 2w)
+        do, v2,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))  # softmax bwd
+    # masked positions have p == 0 => ds == 0 there; no extra mask needed
+
+    dq_ref[0] = (
+        jnp.dot(ds, k2, preferred_element_type=jnp.float32) * scale
+    ).astype(dq_ref.dtype)
+    dk2_ref[0, 0] = (
+        jax.lax.dot_general(  # ds^T @ q -> (2w, d)
+            ds, q,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+    ).astype(dk2_ref.dtype)
+    dv2_ref[0, 0] = jax.lax.dot_general(  # p^T @ dO -> (2w, d)
+        p, do,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(dv2_ref.dtype)
+
+
+def _specs(w: int, d: int):
+    """(q, k_prev, k_cur, v_prev, v_cur) block specs on a (bh, n, d) array.
+    The halo spec points one window back (clamped at 0; program 0 zeroes it
+    in-register)."""
+    cur = lambda b, i: (b, i, 0)
+    prev = lambda b, i: (b, jnp.maximum(i - 1, 0), 0)
+    block = (1, w, d)
+    return [
+        pl.BlockSpec(block, cur, memory_space=pltpu.VMEM),
+        pl.BlockSpec(block, prev, memory_space=pltpu.VMEM),
+        pl.BlockSpec(block, cur, memory_space=pltpu.VMEM),
+        pl.BlockSpec(block, prev, memory_space=pltpu.VMEM),
+        pl.BlockSpec(block, cur, memory_space=pltpu.VMEM),
+    ]
+
+
+def _flops(bh: int, n: int, d: int, w: int, n_matmuls: int) -> pl.CostEstimate:
+    return pl.CostEstimate(
+        flops=n_matmuls * 2 * bh * n * 2 * w * d,
+        transcendentals=bh * n * 2 * w,
+        bytes_accessed=4 * bh * n * d * 4,
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def pallas_local_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    window_size: int,
+    scale: float | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """q, k, v: (batch, heads, n, dim_head), n % window_size == 0.
+    Returns (batch, heads, n, dim_head) in q.dtype. ``interpret=True`` runs
+    the kernel in the Pallas interpreter (CPU tests)."""
+    out, _ = _fwd(q, k, v, window_size, scale, interpret)
+    return out
+
+
+def _fwd(q, k, v, window_size, scale, interpret):
+    b, h, n, d = q.shape
+    w = window_size
+    if n % w != 0:
+        raise ValueError(f"sequence length {n} not divisible by window {w}")
+    if scale is None:
+        scale = d ** -0.5
+    bh, nw = b * h, n // w
+    qf, kf, vf = (t.reshape(bh, n, d) for t in (q, k, v))
+
+    out = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale),
+        grid=(bh, nw),
+        in_specs=_specs(w, d),
+        out_specs=pl.BlockSpec(
+            (1, w, d), lambda b_, i: (b_, i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, n, d), q.dtype),
+        cost_estimate=_flops(bh, n, d, w, 2),
+        interpret=interpret,
+    )(qf, kf, kf, vf, vf)
+    return out.reshape(b, h, n, d), (q, k, v)
+
+
+def _fwd_rule(q, k, v, window_size, scale, interpret):
+    return _fwd(q, k, v, window_size, scale, interpret)
+
+
+def _bwd_rule(window_size, scale, interpret, residuals, g):
+    q, k, v = residuals
+    b, h, n, d = q.shape
+    w = window_size
+    if scale is None:
+        scale = d ** -0.5
+    bh, nw = b * h, n // w
+    qf, kf, vf = (t.reshape(bh, n, d) for t in (q, k, v))
+    gf = g.reshape(bh, n, d)
+
+    halo_block = pl.BlockSpec(
+        (1, 1, 2 * w, d), lambda b_, i: (b_, i, 0, 0), memory_space=pltpu.VMEM
+    )
+    dq, dk2, dv2 = pl.pallas_call(
+        functools.partial(_bwd_kernel, scale=scale),
+        grid=(bh, nw),
+        in_specs=_specs(w, d)
+        + [
+            pl.BlockSpec(
+                (1, w, d), lambda b_, i: (b_, i, 0), memory_space=pltpu.VMEM
+            )
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (1, w, d), lambda b_, i: (b_, i, 0), memory_space=pltpu.VMEM
+            ),
+            halo_block,
+            halo_block,
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, n, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, nw, 2 * w, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, nw, 2 * w, d), jnp.float32),
+        ],
+        cost_estimate=_flops(bh, n, d, w, 5),
+        interpret=interpret,
+    )(qf, kf, kf, vf, vf, gf)
+
+    def combine(d2):
+        """dk[i] = d2[i, cur-half] + d2[i+1, prev-half]; program 0's
+        prev-half (phantom zero keys) is dropped — exactly the reference
+        semantics where those keys are constants."""
+        cur = d2[:, :, w:]
+        nxt = jnp.pad(d2[:, 1:, :w], ((0, 0), (0, 1), (0, 0), (0, 0)))
+        return (cur + nxt).reshape(bh, n, d)
+
+    dk = combine(dk2).astype(k.dtype).reshape(b, h, n, d)
+    dv = combine(dv2).astype(v.dtype).reshape(b, h, n, d)
+    return dq.reshape(b, h, n, d), dk, dv
+
+
+pallas_local_attention.defvjp(_fwd_rule, _bwd_rule)

@@ -120,8 +120,14 @@ def make_mesh(
             raise ValueError(f"{n} devices not divisible by seq*model={rest}")
         data = n // rest
     shape = (data, seq, model)
-    if int(np.prod(shape)) != n:
-        raise ValueError(f"mesh shape {shape} does not match {n} devices")
+    total = int(np.prod(shape))
+    if total > n:
+        raise ValueError(f"mesh shape {shape} needs {total} > {n} devices")
+    if total < n:
+        # explicit smaller mesh: use the first `total` devices (e.g. the
+        # reference-parity single-device default on a multi-device host)
+        devices = devices[:total]
+        n = total
 
     num_slices = len({getattr(d, "slice_index", 0) for d in devices})
     if num_slices > 1 and data % num_slices == 0:

@@ -1,0 +1,103 @@
+"""Sampler tests: top-k selection semantics, decode shape/prime/truncation
+parity with the reference sampler (utils.py:97-135)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from progen_tpu.config import ProGenConfig
+from progen_tpu.models.progen import ProGen
+from progen_tpu.sampling import gumbel_noise, sample, select_top_k
+
+TINY = ProGenConfig(
+    num_tokens=32,
+    dim=32,
+    seq_len=32,
+    depth=2,
+    window_size=8,
+    global_mlp_depth=1,
+    heads=2,
+    dim_head=16,
+    ff_mult=2,
+    dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = ProGen(TINY)
+    tokens = jnp.zeros((1, TINY.seq_len), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), tokens)
+    from flax.core import meta
+
+    return model, meta.unbox(variables)["params"]
+
+
+class TestSelectTopK:
+    def test_mask_keeps_strictly_above_kth_min(self):
+        logits = jnp.array([5.0, 1.0, 3.0, 2.0, 4.0])
+        mask, masked = select_top_k(logits, 3)
+        np.testing.assert_array_equal(
+            mask, [True, False, False, False, True]
+        )  # reference quirk: > min of top-k, so the k-th itself drops
+        np.testing.assert_allclose(masked, [5.0, 0.0, 0.0, 0.0, 4.0])
+
+    def test_gumbel_noise_finite(self):
+        noise = gumbel_noise(jax.random.PRNGKey(0), (1000,))
+        assert jnp.isfinite(noise).all()
+
+
+class TestSample:
+    def test_shape_prime_and_range(self, model_and_params):
+        model, params = model_and_params
+        prime = jnp.array([5, 9, 11], jnp.int32)
+        out = sample(
+            jax.random.PRNGKey(1), model, params, prime, TINY.seq_len,
+            top_k=10, add_bos=True,
+        )
+        out = np.asarray(out)
+        assert out.shape == (TINY.seq_len,)
+        assert out[0] == 0  # BOS
+        np.testing.assert_array_equal(out[1:4], [5, 9, 11])  # prime shifted
+        assert (out >= 0).all() and (out < TINY.num_tokens).all()
+
+    def test_no_bos_prime_in_place(self, model_and_params):
+        model, params = model_and_params
+        prime = jnp.array([5, 9, 11], jnp.int32)
+        out = np.asarray(
+            sample(
+                jax.random.PRNGKey(1), model, params, prime, TINY.seq_len,
+                top_k=10, add_bos=False,
+            )
+        )
+        np.testing.assert_array_equal(out[:3], [5, 9, 11])
+
+    def test_truncation_after_second_zero(self, model_and_params):
+        model, params = model_and_params
+        out = np.asarray(
+            sample(
+                jax.random.PRNGKey(2), model, params,
+                jnp.array([3], jnp.int32), TINY.seq_len, top_k=5,
+                add_bos=True,
+            )
+        )
+        zeros = np.flatnonzero(out == 0)
+        if len(zeros) > 1:  # everything after the 2nd zero must be zero
+            second = zeros[1]
+            assert (out[second:] == 0).all()
+
+    def test_deterministic_given_key(self, model_and_params):
+        model, params = model_and_params
+        prime = jnp.array([7, 2], jnp.int32)
+        a = sample(jax.random.PRNGKey(3), model, params, prime, TINY.seq_len)
+        b = sample(jax.random.PRNGKey(3), model, params, prime, TINY.seq_len)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_prime_too_long_raises(self, model_and_params):
+        model, params = model_and_params
+        with pytest.raises(ValueError):
+            sample(
+                jax.random.PRNGKey(0), model, params,
+                jnp.zeros(TINY.seq_len, jnp.int32), TINY.seq_len,
+            )
