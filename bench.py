@@ -63,6 +63,14 @@ def _prior_round_value() -> float | None:
 def main() -> None:
     import jax
 
+    try:
+        jax.devices()
+    except RuntimeError:
+        # TPU plugin registered but backend unreachable (dead relay): fall
+        # back to CPU so the bench still emits an honest record — the
+        # "platform" key distinguishes the two.
+        jax.config.update("jax_platforms", "cpu")
+
     from progen_tpu.config import ProGenConfig
     from progen_tpu.models.progen import ProGen
     from progen_tpu.parallel.partition import make_mesh, put_batch

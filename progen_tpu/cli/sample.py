@@ -25,12 +25,18 @@ import jax
 @click.option("--checkpoint_path", default="./ckpts")
 @click.option("--prime", default="")
 @click.option("--top_k", default=25)
-def main(seed, checkpoint_path, prime, top_k):
+@click.option(
+    "--naive",
+    default=False,
+    is_flag=True,
+    help="reference-style full forward per token instead of the KV cache",
+)
+def main(seed, checkpoint_path, prime, top_k, naive):
     from progen_tpu.checkpoint import get_checkpoint_fns
     from progen_tpu.config import ProGenConfig
     from progen_tpu.data.tokenizer import decode_tokens, encode_tokens
     from progen_tpu.models.progen import ProGen
-    from progen_tpu.sampling import sample
+    from progen_tpu.sampling import sample, sample_fast
 
     _, get_last, _ = get_checkpoint_fns(checkpoint_path)
     # params-only restore: sampling never needs the optimizer moments
@@ -50,7 +56,8 @@ def main(seed, checkpoint_path, prime, top_k):
     prime_tokens = np.asarray(encode_tokens(prime), dtype=np.int32)
     prime_length = len(prime_tokens) + 1  # +1 for BOS (sample.py:67)
 
-    sampled = sample(
+    sample_fn = sample if naive else sample_fast
+    sampled = sample_fn(
         jax.random.PRNGKey(seed),
         model,
         params,

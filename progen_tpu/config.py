@@ -50,10 +50,16 @@ class ProGenConfig:
     use_pallas_attn: bool = False
     # Rematerialize each block's activations during backprop.
     remat: bool = False
-    # Shard activations' sequence axis over the mesh 'seq' axis (sequence
-    # parallelism via halo exchange); requires seq_len % (seq_shards *
-    # window_size) == 0.
-    sequence_parallel: bool = False
+    # Incremental decoding mode: the model takes ONE token per call and
+    # carries a flax 'cache' collection (rolling 2-window K/V per attention
+    # block, token-shift states, SGU gate history). Same params tree as
+    # decode=False; see sampling.sample_fast.
+    decode: bool = False
+    # NOTE: sequence parallelism is NOT a model flag — it is a property of
+    # the mesh. Build the mesh with seq > 1 (partition.make_mesh) and the
+    # logical rules shard the sequence axis of activations and the SGU's
+    # spatial rows; GSPMD inserts the halo collectives. See
+    # parallel/partition.py and tests/test_partition.py.
 
     @property
     def compute_dtype(self):

@@ -33,6 +33,21 @@ def _dense_init():
     return nn.initializers.lecun_normal()
 
 
+def _cached_shift(module: nn.Module, x: jnp.ndarray) -> jnp.ndarray:
+    """Token-shift for one-token decode: the shifted-in half comes from a
+    cache variable holding the previous position's post-LN features (shared
+    by the attention and feed-forward blocks)."""
+    split = x.shape[-1] - x.shape[-1] // 2
+    st = module.variable(
+        "cache", "shift_state",
+        lambda: jnp.zeros((x.shape[0], 1, split), x.dtype),
+    )
+    shifted = shift_tokens(x, shift_state=st.value)
+    if not module.is_initializing():
+        st.value = x[..., :split]
+    return shifted
+
+
 class ScaleNorm(nn.Module):
     """Scale-only LayerNorm (hk.LayerNorm(create_scale=True, create_offset=False))."""
 
@@ -56,17 +71,23 @@ class ScaleNorm(nn.Module):
 
 
 class LocalAttentionBlock(nn.Module):
+    """Windowed attention block. In config.decode mode the sequence axis is
+    1 and a rolling 2-window K/V cache (flax 'cache' collection) replaces
+    the windowed reshape — O(2w·d) per emitted token instead of a full
+    forward (the reference samples with full-length forwards per token,
+    utils.py:116-117)."""
+
     config: ProGenConfig
 
     @nn.compact
-    def __call__(self, x, sin, cos):
+    def __call__(self, x, sin, cos, pos=None):
         c = self.config
         b, n, _ = x.shape
         h, dh, w = c.heads, c.dim_head, c.window_size
 
         x = ScaleNorm(c.layer_norm_epsilon, c.compute_dtype, c.params_dtype)(x)
         if c.shift_tokens:
-            x = shift_tokens(x)
+            x = _cached_shift(self, x) if c.decode else shift_tokens(x)
 
         qkv = nn.Dense(
             3 * c.inner_dim,
@@ -85,12 +106,19 @@ class LocalAttentionBlock(nn.Module):
 
         q, k, v = map(split_heads, (q, k, v))
 
+        if c.decode:
+            # slice the current position's RoPE row from the full tables
+            sin = jax.lax.dynamic_slice_in_dim(sin, pos, 1, axis=0)
+            cos = jax.lax.dynamic_slice_in_dim(cos, pos, 1, axis=0)
+
         q = apply_rotary_pos_emb(q, sin, cos)
         k = apply_rotary_pos_emb(k, sin, cos)
         if c.rotate_value:  # reference rotates v too (progen.py:87)
             v = apply_rotary_pos_emb(v, sin, cos)
 
-        if c.use_pallas_attn:
+        if c.decode:
+            out = self._decode_attend(q, k, v, pos)  # (b, h, 1, dh)
+        elif c.use_pallas_attn:
             from progen_tpu.ops.pallas_attention import pallas_local_attention
 
             # positional args: custom_vjp nondiff_argnums are positional
@@ -113,16 +141,78 @@ class LocalAttentionBlock(nn.Module):
             name="to_out",
         )(out)
 
+    def _decode_attend(self, q, k, v, pos):
+        """One-token attention against a rolling 2-window K/V ring buffer.
+
+        Slot ``p % 2w`` holds position p; visibility is recomputed per step
+        from the stored absolute positions. Window-0 queries' softmax is
+        diluted by exactly ``w`` phantom zero-score/zero-value keys via an
+        analytic denominator correction — the reference's zero-padded
+        previous window (progen.py:90-96) without materializing it.
+        """
+        c = self.config
+        b, h, _, dh = q.shape
+        w = c.window_size
+        ring = 2 * w
+
+        ck = self.variable(
+            "cache", "k", lambda: jnp.zeros((b, h, ring, dh), q.dtype)
+        )
+        cv = self.variable(
+            "cache", "v", lambda: jnp.zeros((b, h, ring, dh), q.dtype)
+        )
+        cpos = self.variable(
+            "cache", "slot_pos", lambda: jnp.full((ring,), -1, jnp.int32)
+        )
+
+        slot = pos % ring
+        if not self.is_initializing():
+            ck.value = jax.lax.dynamic_update_slice_in_dim(
+                ck.value, k, slot, axis=2
+            )
+            cv.value = jax.lax.dynamic_update_slice_in_dim(
+                cv.value, v, slot, axis=2
+            )
+            cpos.value = jax.lax.dynamic_update_index_in_dim(
+                cpos.value, pos, slot, axis=0
+            )
+
+        slot_pos = cpos.value
+        visible = (
+            (slot_pos >= 0)
+            & (slot_pos <= pos)
+            & (pos // w - slot_pos // w <= 1)
+        )
+        scores = jnp.einsum(
+            "bhqd,bhkd->bhqk", q, ck.value,
+            preferred_element_type=jnp.float32,
+        ) * (dh ** -0.5)
+        scores = jnp.where(visible[None, None, None, :], scores, -1e10)
+
+        first_window = (pos < w).astype(jnp.float32)
+        # softmax with analytic phantom-key dilution: shift-invariant, so a
+        # stable max including the phantoms' score 0 is fine
+        m = jnp.maximum(
+            scores.max(axis=-1, keepdims=True),
+            jnp.where(first_window > 0, 0.0, -jnp.inf),
+        )
+        e = jnp.exp(scores - m)
+        denom = e.sum(axis=-1, keepdims=True) + first_window * w * jnp.exp(-m)
+        out = jnp.einsum(
+            "bhqk,bhkd->bhqd", e, cv.value.astype(jnp.float32)
+        ) / denom
+        return out.astype(q.dtype)
+
 
 class SpatialGatingUnit(nn.Module):
     config: ProGenConfig
     dim_out: int
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, pos=None):
         c = self.config
         n = c.seq_len
-        assert x.shape[-2] == n, (
+        assert c.decode or x.shape[-2] == n, (
             f"SGU is bound to seq_len={n} at init, got sequence {x.shape[-2]}"
         )
         x, gate = jnp.split(x, 2, axis=-1)
@@ -151,7 +241,30 @@ class SpatialGatingUnit(nn.Module):
             c.params_dtype,
         )
 
-        gate = causal_sgu_mix(gate, weights, biases).astype(x.dtype)
+        if c.decode:
+            # incremental spatial mix: keep the LayerNormed gate history and
+            # contract the current causal row of the (n, n) matrix with it —
+            # out[pos] = sum_{j<=pos} W[pos, j] * gate[j] + b[pos]
+            b_sz, half = gate.shape[0], gate.shape[-1]
+            hist = self.variable(
+                "cache", "gate_history",
+                lambda: jnp.zeros((b_sz, n, half), jnp.float32),
+            )
+            if not self.is_initializing():
+                hist.value = jax.lax.dynamic_update_slice_in_dim(
+                    hist.value, gate.astype(jnp.float32), pos, axis=1
+                )
+            row = jax.lax.dynamic_index_in_dim(
+                weights.astype(jnp.float32), pos, axis=0, keepdims=False
+            )
+            row = jnp.where(jnp.arange(n) <= pos, row, 0.0)
+            mixed = jnp.einsum("bnd,n->bd", hist.value, row)
+            mixed = mixed + jax.lax.dynamic_index_in_dim(
+                biases.astype(jnp.float32), pos, axis=0, keepdims=False
+            )
+            gate = mixed[:, None, :].astype(x.dtype)
+        else:
+            gate = causal_sgu_mix(gate, weights, biases).astype(x.dtype)
         x = x * gate
         return nn.Dense(
             self.dim_out,
@@ -171,7 +284,7 @@ class FeedForwardBlock(nn.Module):
     spatial_gate: bool = False
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, pos=None):
         c = self.config
         assert not (self.glu and self.spatial_gate), (
             "glu and sgu cannot be turned on at the same time"
@@ -180,7 +293,7 @@ class FeedForwardBlock(nn.Module):
 
         x = ScaleNorm(c.layer_norm_epsilon, c.compute_dtype, c.params_dtype)(x)
         if c.shift_tokens:
-            x = shift_tokens(x)
+            x = _cached_shift(self, x) if c.decode else shift_tokens(x)
 
         x = nn.Dense(
             hidden,
@@ -198,7 +311,7 @@ class FeedForwardBlock(nn.Module):
             x = jax.nn.gelu(x)
 
         if self.spatial_gate:
-            x = SpatialGatingUnit(c, dim_out=hidden // 2, name="sgu")(x)
+            x = SpatialGatingUnit(c, dim_out=hidden // 2, name="sgu")(x, pos)
 
         x = nn.with_logical_constraint(x, ("batch", "seq_act", "mlp_act"))
         return nn.Dense(

@@ -53,22 +53,35 @@ class ProGen(nn.Module):
         )(tokens)
         x = nn.with_logical_constraint(x, ("batch", "seq_act", "embed_act"))
 
-        # RoPE tables are tiny; build in f32 once per trace (progen.py:227).
-        sin, cos = fixed_pos_embedding(n, c.dim_head)
+        if c.decode:
+            # one-token step: full-length RoPE tables (blocks slice their
+            # row), one shared position counter advanced per call
+            pos_var = self.variable(
+                "cache", "pos", lambda: jnp.zeros((), jnp.int32)
+            )
+            pos = pos_var.value
+            sin, cos = fixed_pos_embedding(c.seq_len, c.dim_head)
+        else:
+            pos = None
+            # RoPE tables are tiny; build in f32 once per trace (progen.py:227)
+            sin, cos = fixed_pos_embedding(n, c.dim_head)
 
         attn_cls, ff_cls = LocalAttentionBlock, FeedForwardBlock
-        if c.remat:
+        if c.remat and not c.decode:
             attn_cls = nn.remat(LocalAttentionBlock)
             ff_cls = nn.remat(FeedForwardBlock)
 
         for i in range(c.depth):
             use_gmlp = (c.depth - i) <= c.global_mlp_depth
             use_glu = (not use_gmlp) and c.ff_glu
-            x = x + attn_cls(c, name=f"attn{i}")(x, sin, cos)
+            x = x + attn_cls(c, name=f"attn{i}")(x, sin, cos, pos)
             x = x + ff_cls(
                 c, glu=use_glu, spatial_gate=use_gmlp, name=f"ff{i}"
-            )(x)
+            )(x, pos)
             x = nn.with_logical_constraint(x, ("batch", "seq_act", "embed_act"))
+
+        if c.decode and not self.is_initializing():
+            pos_var.value = pos + 1
 
         x = ScaleNorm(c.layer_norm_epsilon, c.compute_dtype, c.params_dtype)(x)
         logits = nn.Dense(

@@ -44,6 +44,41 @@ def select_top_k(logits: jnp.ndarray, k: int):
     return mask, jnp.where(mask, logits, 0.0)
 
 
+def _gumbel_topk_step(key, logit, top_k):
+    """One Gumbel-max top-k draw (shared by both decode paths so the
+    sampling quirks stay in lockstep). Returns (new_key, sampled_id)."""
+    key, sub = jax.random.split(key)
+    noise = gumbel_noise(sub, logit.shape)
+    if top_k is not None:
+        mask, logit = select_top_k(logit, top_k)
+        noise = noise * mask
+    return key, jnp.argmax(logit + noise, axis=-1)
+
+
+def _prepare_seq(model, prime, length, add_bos):
+    """Validate and build the fixed-shape decode buffer (shared by both
+    decode paths): BOS shift (utils.py:110-111), right-padding, and the
+    bounds the model can actually serve."""
+    seq_len = model.config.seq_len
+    if length > seq_len:
+        raise ValueError(
+            f"length {length} exceeds the model's seq_len {seq_len} (RoPE "
+            f"tables and the SGU spatial matrix are bound to seq_len)"
+        )
+    prime = jnp.asarray(prime, jnp.int32)
+    start = prime.shape[-1] + (1 if add_bos else 0)
+    if start == 0:
+        raise ValueError("empty prime requires add_bos=True")
+    if start >= length:
+        raise ValueError(f"prime length {start} must be < length {length}")
+    pad = (
+        (1, length - prime.shape[-1] - 1)
+        if add_bos
+        else (0, length - prime.shape[-1])
+    )
+    return jnp.pad(prime, pad), start
+
+
 @functools.partial(jax.jit, static_argnames=("model", "length", "top_k"))
 def _decode(
     model,
@@ -63,15 +98,10 @@ def _decode(
         logit = jax.lax.dynamic_index_in_dim(
             logits, pos - 1, axis=0, keepdims=False
         )
-        key, sub = jax.random.split(key)
-        noise = gumbel_noise(sub, logit.shape)
-        if top_k is not None:
-            mask, logit = select_top_k(logit, top_k)
-            noise = noise * mask
-        sampled = jnp.argmax(logit + noise, axis=-1).astype(seq.dtype)
-        # write only if pos >= start_pos (loop starts there, always true;
-        # kept branch-free)
-        seq = jax.lax.dynamic_update_index_in_dim(seq, sampled, pos, axis=0)
+        key, sampled = _gumbel_topk_step(key, logit, top_k)
+        seq = jax.lax.dynamic_update_index_in_dim(
+            seq, sampled.astype(seq.dtype), pos, axis=0
+        )
         return seq, key
 
     seq, _ = jax.lax.fori_loop(start_pos, length, body, (seq, key))
@@ -94,12 +124,76 @@ def sample(
     Defaults mirror sample.py:70 (top_k=25; train-loop sampling uses
     add_bos=True, train.py:218).
     """
-    prime = jnp.asarray(prime, jnp.int32)
-    start = prime.shape[-1] + (1 if add_bos else 0)
-    if start >= length:
-        raise ValueError(f"prime length {start} must be < length {length}")
-    pad = (1, length - prime.shape[-1] - 1) if add_bos else (0, length - prime.shape[-1])
-    seq = jnp.pad(prime, pad)
+    seq, start = _prepare_seq(model, prime, length, add_bos)
     return _decode(
         model, params, key, seq, jnp.asarray(start), length, top_k
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("model", "length", "top_k"))
+def _decode_incremental(model, params, cache, key, seq, start_pos, length, top_k):
+    """Single fused decode: prefill the cache over the prime, then one
+    cache-backed forward per generated token."""
+
+    def feed(seq, p, cache):
+        tok = jax.lax.dynamic_slice(seq, (p,), (1,))[None]  # (1, 1)
+        logits, mut = model.apply(
+            {"params": params, "cache": cache}, tok, mutable=["cache"]
+        )
+        return logits[0, 0], mut["cache"]
+
+    def prefill(p, cache):
+        _, cache = feed(seq, p, cache)
+        return cache
+
+    cache = jax.lax.fori_loop(0, start_pos - 1, prefill, cache)
+
+    def gen(p, carry):
+        # feeding seq[p] (which may itself be a generated token — read from
+        # the CARRY, not the traced-in buffer) yields position p+1's logits
+        seq, cache, key = carry
+        logit, cache = feed(seq, p, cache)
+        key, sampled = _gumbel_topk_step(key, logit, top_k)
+        seq = jax.lax.dynamic_update_index_in_dim(
+            seq, sampled.astype(seq.dtype), p + 1, axis=0
+        )
+        return seq, cache, key
+
+    seq, _, _ = jax.lax.fori_loop(
+        start_pos - 1, length - 1, gen, (seq, cache, key)
+    )
+    after_eos = jnp.cumsum(seq == 0, axis=-1) > 1
+    return seq * (~after_eos)
+
+
+def sample_fast(
+    key: jax.Array,
+    model,
+    params,
+    prime: jnp.ndarray,
+    length: int,
+    top_k: Optional[int] = 25,
+    add_bos: bool = False,
+) -> jnp.ndarray:
+    """KV-cache decode: O(2w·d) attention per emitted token via the model's
+    config.decode mode (rolling two-window ring buffer + token-shift states
+    + SGU gate history) instead of the naive path's full forward per token.
+    Same sampling semantics as `sample`."""
+    import dataclasses
+
+    from progen_tpu.models.progen import ProGen
+
+    dec_model = ProGen(dataclasses.replace(model.config, decode=True))
+
+    seq, start = _prepare_seq(model, prime, length, add_bos)
+
+    # cache skeleton: params creation inside init is dead-code-eliminated
+    # under jit since only the cache collection is returned
+    cache = jax.jit(
+        lambda: dec_model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 1), jnp.int32)
+        )["cache"]
+    )()
+    return _decode_incremental(
+        dec_model, params, cache, key, seq, jnp.asarray(start), length, top_k
     )
