@@ -1,0 +1,142 @@
+"""ctypes loader/builder for the native TFRecord engine.
+
+Compiles native/tfrecord_io.cc with g++ on first use (no pybind11 in the
+image; plain C ABI + ctypes) and caches the .so next to the source keyed by
+a content hash, so editing the C++ transparently rebuilds. Set
+``PROGEN_TPU_NATIVE=0`` to force the pure-Python codec.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+from pathlib import Path
+from typing import Optional
+
+_SRC = Path(__file__).resolve().parents[2] / "native" / "tfrecord_io.cc"
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _build(src: Path) -> Path:
+    digest = hashlib.sha256(src.read_bytes()).hexdigest()[:16]
+    out = src.parent / f"libtfrecord_io_{digest}.so"
+    if not out.exists():
+        # per-process tmp: concurrent builders each write their own file and
+        # the atomic rename publishes whichever finishes (identical content)
+        tmp = out.with_suffix(f".so.tmp.{os.getpid()}")
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", str(tmp), str(src)],
+            check=True,
+            capture_output=True,
+        )
+        os.replace(tmp, out)
+        for stale in src.parent.glob("libtfrecord_io_*.so"):
+            if stale != out:
+                stale.unlink(missing_ok=True)
+    return out
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The native library, or None (missing toolchain/source, or opted out)."""
+    global _lib, _load_failed
+    if _lib is not None:
+        return _lib
+    if _load_failed or os.environ.get("PROGEN_TPU_NATIVE") == "0":
+        return None
+    try:
+        lib = ctypes.CDLL(str(_build(_SRC)))
+    except (OSError, subprocess.CalledProcessError, FileNotFoundError):
+        _load_failed = True
+        return None
+
+    lib.tfio_crc32c.restype = ctypes.c_uint32
+    lib.tfio_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_long]
+    lib.tfio_masked_crc.restype = ctypes.c_uint32
+    lib.tfio_masked_crc.argtypes = [ctypes.c_char_p, ctypes.c_long]
+    lib.tfio_parse_records.restype = ctypes.c_long
+    lib.tfio_parse_records.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_long,
+        ctypes.POINTER(ctypes.c_long),
+        ctypes.POINTER(ctypes.c_long),
+        ctypes.c_long,
+        ctypes.c_int,
+    ]
+    lib.tfio_example_seq.restype = ctypes.c_long
+    lib.tfio_example_seq.argtypes = [
+        ctypes.c_void_p,  # payload pointer (base + offset, zero-copy)
+        ctypes.c_long,
+        ctypes.c_char_p,
+        ctypes.c_long,
+        ctypes.POINTER(ctypes.c_long),
+    ]
+    lib.tfio_encoded_size.restype = ctypes.c_long
+    lib.tfio_encoded_size.argtypes = [ctypes.c_long, ctypes.c_long]
+    lib.tfio_encode_record.restype = ctypes.c_long
+    lib.tfio_encode_record.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_long,
+        ctypes.c_char_p,
+        ctypes.c_long,
+        ctypes.c_char_p,
+        ctypes.c_long,
+    ]
+    _lib = lib
+    return _lib
+
+
+def parse_file(data: bytes, key: bytes = b"seq", verify_crc: bool = True):
+    """Decompressed TFRecord buffer -> list of `key` feature bytes, all
+    framing/proto work in C++. Returns None if the library is unavailable.
+
+    Memory bound: the caller's buffer + 16 bytes of offset bookkeeping per
+    record (records are >= 16 bytes, so <= 1x buffer) + one extracted
+    sequence at a time; shard size is capped by the ETL's
+    num_sequences_per_file, so whole-shard buffers are intended."""
+    lib = load()
+    if lib is None:
+        return None
+    max_records = max(1, len(data) // 16)  # min framed record = 16 bytes
+    offsets = (ctypes.c_long * max_records)()
+    lengths = (ctypes.c_long * max_records)()
+    n = lib.tfio_parse_records(
+        data, len(data), offsets, lengths, max_records, int(verify_crc)
+    )
+    if n < 0:
+        raise ValueError(f"corrupt tfrecord buffer at byte {-(n + 1)}")
+    # zero-copy payload access: pass base_address + offset into the same
+    # buffer; only the final per-sequence bytes are copied out
+    base = ctypes.cast(ctypes.c_char_p(data), ctypes.c_void_p).value
+    out = []
+    seq_off = ctypes.c_long()
+    for i in range(n):
+        slen = lib.tfio_example_seq(
+            ctypes.c_void_p(base + offsets[i]),
+            lengths[i],
+            key,
+            len(key),
+            ctypes.byref(seq_off),
+        )
+        if slen < 0:
+            raise KeyError(f"feature {key!r} not found in record {i}")
+        start = offsets[i] + seq_off.value
+        out.append(data[start : start + slen])
+    return out
+
+
+def encode_record(seq: bytes, key: bytes = b"seq") -> Optional[bytes]:
+    """One framed TFRecord (header+crc+Example+crc) built in C++, or None."""
+    lib = load()
+    if lib is None:
+        return None
+    size = lib.tfio_encoded_size(len(seq), len(key))
+    buf = ctypes.create_string_buffer(size)
+    written = lib.tfio_encode_record(
+        seq, len(seq), key, len(key), buf, size
+    )
+    if written < 0:
+        raise RuntimeError("native encode buffer undersized (bug)")
+    return buf.raw[:written]

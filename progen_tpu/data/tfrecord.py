@@ -197,17 +197,35 @@ def read_records(fp) -> Iterator[bytes]:
 def tfrecord_writer(path: str, key: str = "seq"):
     """Context manager yielding ``write(seq_bytes)`` — gzip TFRecord file of
     single-bytes-feature Examples, like the reference's
-    ``with_tfrecord_writer`` (data.py:16-21)."""
+    ``with_tfrecord_writer`` (data.py:16-21). Record encoding (proto +
+    framing + CRC) runs in the native C++ engine when available."""
+    from progen_tpu.data import _native
+
     with gzip.open(path, "wb") as fp:
 
         def write(seq: bytes) -> None:
-            write_record(fp, encode_example(seq, key))
+            rec = _native.encode_record(seq, key.encode())
+            if rec is not None:
+                fp.write(rec)
+            else:
+                write_record(fp, encode_example(seq, key))
 
         yield write
 
 
 def read_tfrecords(path: str, key: str = "seq") -> Iterator[bytes]:
-    """Yield the ``key`` feature of every Example in a gzip TFRecord file."""
+    """Yield the ``key`` feature of every Example in a gzip TFRecord file.
+
+    Fast path: decompress the whole file and batch-parse framing + proto in
+    the native C++ engine (one ctypes call for all records); falls back to
+    the pure-Python streaming codec."""
+    from progen_tpu.data import _native
+
+    if _native.load() is not None:
+        with gzip.open(path, "rb") as fp:
+            data = fp.read()
+        yield from _native.parse_file(data, key.encode())
+        return
     with gzip.open(path, "rb") as fp:
         for payload in read_records(fp):
             yield decode_example(payload, key)
