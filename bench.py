@@ -21,28 +21,9 @@ from __future__ import annotations
 
 import glob
 import json
-import os
-import re
 import time
 
 import numpy as np
-
-PEAK_BF16_FLOPS = {
-    "v4": 275e12,
-    "v5e": 197e12,
-    "v5 lite": 197e12,
-    "v5p": 459e12,
-    "v6e": 918e12,
-}
-
-
-def _peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "").lower()
-    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
-    for key, val in PEAK_BF16_FLOPS.items():
-        if key in kind or (gen and key in gen):
-            return val
-    return 197e12
 
 
 def _prior_round_value() -> float | None:
@@ -120,13 +101,14 @@ def main() -> None:
     tokens_per_sec = tokens_per_step * n_iters / dt
     per_chip = tokens_per_sec / n_chips
 
+    from progen_tpu import profiling
+
     num_params = state.num_params()
-    flops_per_token = (
-        6 * num_params
-        + 12 * config.depth * config.heads * config.dim_head
-        * (2 * config.window_size)
+    mfu = (
+        per_chip
+        * profiling.flops_per_token(config)
+        / profiling.peak_flops(jax.devices()[0])
     )
-    mfu = per_chip * flops_per_token / _peak_flops(jax.devices()[0])
 
     prior = _prior_round_value()
     result = {

@@ -55,6 +55,9 @@ def confirm(question: str) -> bool:
 @click.option("--mesh_seq", default=1, help="sequence-parallel mesh axis size")
 @click.option("--mesh_model", default=1, help="tensor-parallel mesh axis size")
 @click.option("--num_steps", default=0, help="stop after N optimizer steps (0 = full data)")
+@click.option("--profile_dir", default="", help="jax.profiler trace dir for steps 2-4")
+@click.option("--hardware_rng", default=False, is_flag=True,
+              help="TPU-fast partitionable rbg PRNG (ref: set_hardware_rng_)")
 def main(
     seed,
     batch_size,
@@ -81,6 +84,8 @@ def main(
     mesh_seq,
     mesh_model,
     num_steps,
+    profile_dir,
+    hardware_rng,
 ):
     from progen_tpu.checkpoint import Package, get_checkpoint_fns
     from progen_tpu.config import ProGenConfig, load_toml_config
@@ -103,6 +108,10 @@ def main(
         compile_eval_step,
     )
 
+    if hardware_rng:
+        from progen_tpu.utils.rng import use_hardware_rng
+
+        use_hardware_rng()
     initialize_distributed()
 
     reset_ckpt, get_last, save_ckpt = get_checkpoint_fns(
@@ -211,22 +220,51 @@ def main(
 
     import tqdm
 
+    from progen_tpu import profiling
+
+    timer = profiling.StepTimer(
+        n_chips=len(jax.devices()),
+        flops_per_tok=profiling.flops_per_token(config),
+        peak=profiling.peak_flops(jax.devices()[0]),
+    )
+    import math
+
     seq_indices = range(start_seq_index, num_train, effective_batch)
     steps_done = 0
+    profiler_active = False
     # metric step continues across resumes (state.step is checkpointed);
     # a restarted loop must not rewind the tracker's step axis
     start_step = int(jax.device_get(state.step))
-    with mesh:
+    try:
+      with mesh:
         for i, seq_index in enumerate(tqdm.tqdm(seq_indices, mininterval=10)):
             if num_steps and steps_done >= num_steps:
                 break
+            if profile_dir and i == 2:
+                from jax import profiler as jax_profiler
+
+                jax_profiler.start_trace(profile_dir)
+                profiler_active = True
             state, metrics = train_step(state, next_super_batch())
             steps_done += 1
             global_step = start_step + steps_done
-            loss = float(metrics["last_micro_loss"])
+            loss = float(metrics["last_micro_loss"])  # host sync = timing fence
+            if profiler_active and i >= 4:
+                from jax import profiler as jax_profiler
+
+                jax_profiler.stop_trace()
+                profiler_active = False
+            if not math.isfinite(loss):
+                # failure detection (SURVEY §5): stop before a NaN spreads
+                # into the checkpoint rotation
+                raise RuntimeError(
+                    f"non-finite loss {loss} at step {global_step}; "
+                    f"last checkpoint is intact — restart resumes from it"
+                )
+            perf = timer.tick(effective_batch * config.seq_len)
             if is_coordinator():
                 print(f"loss: {loss:.4f}")
-            tracker.log({"loss": loss}, step=global_step)
+            tracker.log({"loss": loss, **(perf or {})}, step=global_step)
 
             next_seq_index = seq_index + effective_batch
             if i % checkpoint_every == 0:
@@ -274,6 +312,12 @@ def main(
                     render_sample_html(prime_str, sampled_str),
                     step=global_step,
                 )
+
+    finally:
+        if profiler_active:
+            from jax import profiler as jax_profiler
+
+            jax_profiler.stop_trace()
 
     # final checkpoint so short runs (e.g. --num_steps) always persist;
     # next_seq_index counts exactly the records consumed by executed steps

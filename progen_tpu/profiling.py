@@ -1,0 +1,90 @@
+"""Profiling / throughput observability (SURVEY §5: absent in the reference
+beyond tqdm — /root/reference/train.py:184; the north-star metric is
+tokens/sec/chip + MFU, BASELINE.md).
+
+Pieces:
+  * ``flops_per_token`` — PaLM-convention accounting: 6*params for the
+    dense math (fwd + bwd) + 12*L*H*Dh*ctx for attention score/value
+    matmuls, ctx = 2*window for this model's [prev|cur] windowed attention.
+  * ``peak_flops`` — bf16 peak per chip by device kind (v5e default).
+  * ``StepTimer`` — wall-clock per optimizer step -> tokens/sec/chip and
+    MFU, with warmup skipping so compile time never pollutes the numbers.
+  * the train CLI starts/stops ``jax.profiler`` traces around steps 2-4
+    (``--profile_dir``), viewable in TensorBoard/XProf.
+
+bench.py and the train CLI both consume these so the two always agree on
+the FLOPs math.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+PEAK_BF16_FLOPS = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5 lite": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+}
+_DEFAULT_PEAK = 197e12  # v5e
+
+
+def flops_per_token(config) -> int:
+    """Training FLOPs per token (fwd+bwd), PaLM MFU convention."""
+    attn_ctx = 2 * config.window_size
+    return (
+        6 * config.num_params()
+        + 12 * config.depth * config.heads * config.dim_head * attn_ctx
+    )
+
+
+def peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    for key, val in PEAK_BF16_FLOPS.items():
+        if key in kind or (gen and key in gen):
+            return val
+    return _DEFAULT_PEAK
+
+
+class StepTimer:
+    """Tracks per-step wall time and derives throughput metrics.
+
+    Call ``tick(tokens)`` once per optimizer step AFTER the step's result
+    has been observed on the host (e.g. float(loss) — that sync is the
+    timing fence). The first ``warmup`` ticks are discarded (compile)."""
+
+    def __init__(self, n_chips: int, flops_per_tok: int, peak: float,
+                 warmup: int = 2):
+        self.n_chips = max(n_chips, 1)
+        self.flops_per_tok = flops_per_tok
+        self.peak = peak
+        self.warmup = warmup
+        self._last: Optional[float] = None
+        self._steps = 0
+        self._time = 0.0
+        self._tokens = 0
+
+    def tick(self, tokens: int) -> Optional[dict]:
+        """Returns {step_ms, tokens_per_sec_per_chip, mfu} once measuring
+        (post-warmup), else None."""
+        now = time.perf_counter()
+        if self._last is None:
+            self._last = now
+            return None
+        dt, self._last = now - self._last, now
+        self._steps += 1
+        if self._steps <= self.warmup:
+            return None
+        self._time += dt
+        self._tokens += tokens
+        per_chip = self._tokens / self._time / self.n_chips
+        return {
+            "step_ms": 1000.0 * dt,
+            "tokens_per_sec_per_chip": per_chip,
+            "mfu": per_chip * self.flops_per_tok / self.peak,
+        }
+

@@ -1,0 +1,27 @@
+"""Hardware-accelerated RNG — the TPU-native take on the reference's
+``set_hardware_rng_`` (/root/reference/progen_transformer/utils.py:139-158).
+
+The reference monkeypatches ``jax.random.uniform``/``bernoulli`` (reaching
+into ``jax._src``) with key-ignoring ``lax.rng_uniform`` for XLA speed, at
+the cost of losing determinism AND reproducibility-by-seed. The supported
+modern equivalent is switching JAX's PRNG implementation to ``rbg``
+(``jax_default_prng_impl``): it lowers to the TPU's fast hardware RNG path,
+stays keyed/splittable (seeds still reproduce), and is partitionable under
+GSPMD so sharded programs don't serialize on random-bit generation.
+
+Call before creating any keys (CLI entry points do it first thing).
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def use_hardware_rng() -> None:
+    """Switch the default PRNG to the TPU-fast, partitionable ``rbg``."""
+    jax.config.update("jax_default_prng_impl", "rbg")
+
+
+def use_default_rng() -> None:
+    """Back to threefry2x32 (bit-exact cross-platform reproducibility)."""
+    jax.config.update("jax_default_prng_impl", "threefry2x32")

@@ -1,0 +1,78 @@
+"""Profiling helpers: FLOPs accounting, StepTimer, hardware-RNG switch."""
+
+import time
+
+import pytest
+
+from progen_tpu import profiling
+from progen_tpu.config import ProGenConfig
+
+
+class TestFlops:
+    def test_flops_per_token_scales_with_params(self):
+        small = ProGenConfig(dim=256, depth=4, seq_len=512, window_size=128)
+        big = ProGenConfig(dim=512, depth=8, seq_len=512, window_size=128)
+        assert profiling.flops_per_token(big) > profiling.flops_per_token(
+            small
+        )
+        # dominated by 6N
+        assert profiling.flops_per_token(small) > 6 * small.num_params()
+
+    def test_peak_flops_default(self):
+        class Dev:
+            device_kind = "unknown thing"
+
+        import os
+
+        old = os.environ.pop("PALLAS_AXON_TPU_GEN", None)
+        try:
+            assert profiling.peak_flops(Dev()) == 197e12
+        finally:
+            if old is not None:
+                os.environ["PALLAS_AXON_TPU_GEN"] = old
+
+    def test_peak_flops_by_kind(self):
+        class Dev:
+            device_kind = "TPU v4"
+
+        assert profiling.peak_flops(Dev()) == 275e12
+
+
+class TestStepTimer:
+    def test_warmup_skipped_then_metrics(self):
+        t = profiling.StepTimer(
+            n_chips=2, flops_per_tok=1000, peak=1e6, warmup=1
+        )
+        assert t.tick(100) is None  # establishes t0
+        assert t.tick(100) is None  # warmup step discarded
+        time.sleep(0.01)
+        out = t.tick(100)
+        assert out is not None
+        assert out["tokens_per_sec_per_chip"] > 0
+        assert 0 < out["mfu"] < 1e6
+        assert out["step_ms"] >= 10.0
+
+    def test_mfu_formula(self):
+        t = profiling.StepTimer(n_chips=1, flops_per_tok=10, peak=1e3,
+                                warmup=0)
+        t.tick(0)
+        time.sleep(0.005)
+        out = t.tick(50)
+        assert out["mfu"] == pytest.approx(
+            out["tokens_per_sec_per_chip"] * 10 / 1e3
+        )
+
+
+class TestHardwareRng:
+    def test_switch_and_restore(self):
+        import jax
+
+        from progen_tpu.utils.rng import use_default_rng, use_hardware_rng
+
+        try:
+            use_hardware_rng()
+            key = jax.random.PRNGKey(0)
+            # rbg keys are 4x uint32
+            assert jax.random.uniform(key, (4,)).shape == (4,)
+        finally:
+            use_default_rng()
