@@ -15,7 +15,18 @@ on the trailing `global_mlp_depth` layers.
 
 __version__ = "0.1.0"
 
-from progen_tpu.config import ProGenConfig
-from progen_tpu.models.progen import ProGen
-
 __all__ = ["ProGen", "ProGenConfig", "__version__"]
+
+
+def __getattr__(name):  # PEP 562: lazy so that importing light submodules
+    # (progen_tpu.utils.env, loaded by the CLIs BEFORE jax to honor .env
+    # XLA flags) does not drag in jax via the model imports
+    if name == "ProGen":
+        from progen_tpu.models.progen import ProGen
+
+        return ProGen
+    if name == "ProGenConfig":
+        from progen_tpu.config import ProGenConfig
+
+        return ProGenConfig
+    raise AttributeError(f"module 'progen_tpu' has no attribute {name!r}")

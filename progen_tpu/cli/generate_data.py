@@ -9,6 +9,10 @@ Run: python -m progen_tpu.cli.generate_data --data_dir ./configs/data
 
 from __future__ import annotations
 
+from progen_tpu.utils.env import load_env_file
+
+load_env_file()  # XLA/env flags before jax import (ref train.py:1-2)
+
 from pathlib import Path
 
 import click

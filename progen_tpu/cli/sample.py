@@ -12,6 +12,10 @@ Run: python -m progen_tpu.cli.sample --prime "[tax=Mammalia] #"
 
 from __future__ import annotations
 
+from progen_tpu.utils.env import load_env_file
+
+load_env_file()  # XLA/env flags before jax import (ref train.py:1-2)
+
 import sys
 
 import click

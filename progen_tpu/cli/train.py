@@ -15,6 +15,10 @@ Run: python -m progen_tpu.cli.train [flags]
 
 from __future__ import annotations
 
+from progen_tpu.utils.env import load_env_file
+
+load_env_file()  # XLA/env flags before jax import (ref train.py:1-2)
+
 import sys
 from pathlib import Path
 

@@ -1,0 +1,35 @@
+"""Tiny ``.env`` loader — parity with the reference's ``load_dotenv()``
+(/root/reference/train.py:1-2, sample.py:1-2; its ``.env`` carries XLA env
+flags). python-dotenv is not in this image, and the needed subset is 10
+lines: KEY=VALUE lines, ``#`` comments, optional ``export`` prefix,
+existing environment wins (dotenv's default override=False).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+
+def load_env_file(path: str = ".env") -> dict:
+    """Load KEY=VALUE pairs into os.environ (existing keys win). Returns
+    the parsed mapping; missing file -> empty dict, like load_dotenv."""
+    p = Path(path)
+    if not p.exists():
+        return {}
+    parsed = {}
+    for line in p.read_text().splitlines():
+        line = line.strip()
+        if not line or line.startswith("#") or "=" not in line:
+            continue
+        if line.startswith("export "):
+            line = line[len("export ") :]
+        key, _, value = line.partition("=")
+        key, value = key.strip(), value.strip()
+        if value and value[0] in "'\"":  # quoted: keep everything inside
+            value = value.strip(value[0])
+        else:  # unquoted: dotenv strips trailing inline comments
+            value = value.split(" #", 1)[0].split("\t#", 1)[0].strip()
+        parsed[key] = value
+        os.environ.setdefault(key, value)
+    return parsed

@@ -129,3 +129,31 @@ def test_remat_matches():
 def test_seq_len_window_divisibility_enforced():
     with pytest.raises(ValueError):
         ProGenConfig(seq_len=100, window_size=32)
+
+
+def test_long8k_config_shape_soundness():
+    """The long-context BASELINE config (seq 8192, window 512) must trace:
+    abstract-only (eval_shape) train step — catches any shape/window/SGU
+    wiring error at that scale without paying the FLOPs."""
+    from progen_tpu.config import load_toml_config
+    from progen_tpu.training.optimizer import make_optimizer
+    from progen_tpu.training.step import (
+        abstract_train_state,
+        make_train_step,
+    )
+
+    from pathlib import Path
+
+    toml = Path(__file__).parents[1] / "configs" / "model" / "long8k.toml"
+    cfg = ProGenConfig.from_dict(load_toml_config(str(toml)))
+    assert cfg.seq_len == 8192 and cfg.window_size == 512
+    model = ProGen(cfg)
+    optimizer = make_optimizer()
+    _, abstract = abstract_train_state(model, optimizer, cfg.seq_len)
+    step = make_train_step(model, optimizer)
+    batch = jax.ShapeDtypeStruct((1, 2, cfg.seq_len + 1), jnp.int32)
+    out_state, metrics = jax.eval_shape(step, abstract, batch)
+    assert metrics["loss"].shape == ()
+    # SGU spatial matrices really are (8192, 8192) on the last two layers
+    sgu = out_state.params["ff11"]["sgu"]["spatial_weights"]
+    assert sgu.shape == (8192, 8192)

@@ -137,6 +137,85 @@ class TestReferenceParity:
             np.asarray(logits), np.asarray(ref_logits), atol=2e-4, rtol=2e-4
         )
 
+    def test_training_step_matches_reference_math(self):
+        """Three optimizer steps of OUR donated train step must land on the
+        same weights as the reference's own training machinery (its
+        get_loss_fn + chain(clip, masked adamw, apply_every) — reference
+        utils.py:61-93, train.py:113-121) run on the reference model, with
+        grad_accum=1 so the documented accumulation-order delta is moot."""
+        import optax
+
+        from progen_tpu.training.optimizer import make_optimizer
+        from progen_tpu.training.state import TrainState
+        from progen_tpu.training.step import make_train_step
+
+        from progen_transformer.utils import get_loss_fn
+
+        ref_model = RefProGen(
+            num_tokens=CFG.num_tokens,
+            dim=CFG.dim,
+            depth=CFG.depth,
+            window_size=CFG.window_size,
+            global_mlp_depth=CFG.global_mlp_depth,
+            heads=CFG.heads,
+            dim_head=CFG.dim_head,
+            ff_mult=CFG.ff_mult,
+            seq_len=CFG.seq_len,
+        )
+        rng = jax.random.PRNGKey(0)
+        ref_params = ref_model.init(
+            rng, jnp.zeros((CFG.seq_len,), jnp.uint8)
+        )
+        batches = [
+            jax.random.randint(
+                jax.random.PRNGKey(100 + i), (2, CFG.seq_len + 1), 0,
+                CFG.num_tokens,
+            )
+            for i in range(3)
+        ]
+
+        # --- reference training loop (their loss fn + optimizer chain)
+        ref_loss_fn = get_loss_fn(ref_model, data_parallel=False)
+        ref_optim = optax.chain(
+            optax.clip_by_global_norm(0.5),
+            optax.adamw(
+                2e-4,
+                weight_decay=1e-3,
+                mask=lambda p: jax.tree.map(lambda x: x.ndim > 1, p),
+            ),
+            optax.apply_every(1),
+        )
+        ref_opt_state = ref_optim.init(ref_params)
+        p = ref_params
+        for data in batches:
+            (_, grads) = ref_loss_fn(p, rng, jnp.asarray(data, jnp.uint16))
+            updates, ref_opt_state = ref_optim.update(grads, ref_opt_state, p)
+            p = optax.apply_updates(p, updates)
+        ref_final = p
+
+        # --- our train step on transplanted params
+        ours = ProGen(CFG)
+        params = transplant(
+            jax.tree.map(np.asarray, dict(ref_params)), CFG.depth
+        )
+        optimizer = make_optimizer(2e-4, 1e-3, 0.5)
+        state = TrainState.create(params, optimizer)
+        step = jax.jit(make_train_step(ours, optimizer))
+        for data in batches:
+            state, _ = step(state, jnp.asarray(data, jnp.int32)[None])
+
+        expected = transplant(
+            jax.tree.map(np.asarray, dict(ref_final)), CFG.depth
+        )
+        exp_leaves = jax.tree_util.tree_flatten_with_path(expected)[0]
+        got_leaves = jax.tree_util.tree_flatten_with_path(state.params)[0]
+        assert len(exp_leaves) == len(got_leaves)
+        for (ka, a), (kb, b) in zip(exp_leaves, got_leaves):
+            assert jax.tree_util.keystr(ka) == jax.tree_util.keystr(kb)
+            np.testing.assert_allclose(
+                a, b, atol=5e-6, err_msg=jax.tree_util.keystr(ka)
+            )
+
     def test_parity_without_token_shift_and_glu(self):
         """Exercise the GELU (non-GLU) path and shift_tokens=False."""
         cfg = ProGenConfig(
