@@ -26,6 +26,19 @@ import time
 import numpy as np
 
 
+def _device_or_cpu_fallback():
+    """jax.devices() with a CPU fallback when the TPU plugin is registered
+    but its backend is unreachable (dead relay) — the 'platform' key in the
+    emitted JSON distinguishes the two."""
+    import jax
+
+    try:
+        return jax.devices()
+    except RuntimeError:
+        jax.config.update("jax_platforms", "cpu")
+        return jax.devices()
+
+
 def _prior_round_value() -> float | None:
     best = None
     for path in sorted(glob.glob("BENCH_r*.json")):
@@ -44,13 +57,7 @@ def _prior_round_value() -> float | None:
 def main() -> None:
     import jax
 
-    try:
-        jax.devices()
-    except RuntimeError:
-        # TPU plugin registered but backend unreachable (dead relay): fall
-        # back to CPU so the bench still emits an honest record — the
-        # "platform" key distinguishes the two.
-        jax.config.update("jax_platforms", "cpu")
+    _device_or_cpu_fallback()
 
     from progen_tpu.config import ProGenConfig
     from progen_tpu.models.progen import ProGen
@@ -126,5 +133,78 @@ def main() -> None:
     print(json.dumps(result))
 
 
+def kernel_bench() -> None:
+    """`python bench.py kernel` — Pallas windowed-attention kernel vs the
+    XLA path, fwd+bwd, tiny-config shapes. Not part of the driver contract
+    (which reads main()'s single line); records the kernel delta the
+    VERDICT asked for."""
+    import jax
+    import jax.numpy as jnp
+
+    _device_or_cpu_fallback()
+
+    from progen_tpu.ops.attention import local_attention
+    from progen_tpu.ops.pallas_attention import pallas_local_attention
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        b, h, n, d, w = 16, 8, 1024, 64, 256
+    else:
+        # interpret-mode Pallas is minutes/call at the TPU shapes — keep the
+        # off-TPU path a functional smoke, not a perf claim
+        b, h, n, d, w = 2, 2, 128, 32, 32
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (
+        jax.random.normal(kk, (b, h, n, d), jnp.bfloat16) for kk in ks
+    )
+
+    def time_fn(fn, iters=20):
+        out = jax.block_until_ready(fn(q, k, v))  # compile
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(q, k, v)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters, out
+
+    xla_fwd = jax.jit(lambda q, k, v: local_attention(q, k, v, window_size=w))
+    # interpret mode on CPU (compiled Mosaic is TPU-only)
+    pl_fwd = jax.jit(
+        lambda q, k, v: pallas_local_attention(q, k, v, w, None, not on_tpu)
+    )
+    xla_bwd = jax.jit(
+        jax.grad(lambda q, k, v: local_attention(q, k, v, window_size=w)
+                 .astype(jnp.float32).sum(), argnums=(0, 1, 2))
+    )
+    pl_bwd = jax.jit(
+        jax.grad(lambda q, k, v: pallas_local_attention(q, k, v, w, None,
+                                                        not on_tpu)
+                 .astype(jnp.float32).sum(), argnums=(0, 1, 2))
+    )
+
+    t_xf, o_x = time_fn(xla_fwd)
+    t_pf, o_p = time_fn(pl_fwd)
+    err = float(
+        jnp.abs(o_x.astype(jnp.float32) - o_p.astype(jnp.float32)).max()
+    )
+    t_xb, _ = time_fn(xla_bwd, iters=10)
+    t_pb, _ = time_fn(pl_bwd, iters=10)
+    print(json.dumps({
+        "metric": "pallas_vs_xla_local_attention",
+        "fwd_ms": {"xla": round(t_xf * 1e3, 2), "pallas": round(t_pf * 1e3, 2)},
+        "bwd_ms": {"xla": round(t_xb * 1e3, 2), "pallas": round(t_pb * 1e3, 2)},
+        "fwd_speedup": round(t_xf / t_pf, 2),
+        "bwd_speedup": round(t_xb / t_pb, 2),
+        "max_abs_err": err,
+        "shape": f"b{b} h{h} n{n} d{d} w{w} bf16",
+        "platform": jax.devices()[0].platform,
+        "pallas_interpret_mode": not on_tpu,
+    }))
+
+
 if __name__ == "__main__":
-    main()
+    import sys
+
+    if len(sys.argv) > 1 and sys.argv[1] == "kernel":
+        kernel_bench()
+    else:
+        main()

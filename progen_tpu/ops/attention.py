@@ -41,10 +41,17 @@ def local_attention(
     window_size: int,
     scale: float | None = None,
     mask_value: float = ATTN_MASK_VALUE,
+    first_prev_k: jnp.ndarray | None = None,
+    first_prev_v: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """q, k, v: (batch, heads, n, dim_head) with n % window_size == 0.
 
     Returns (batch, heads, n, dim_head) in q.dtype.
+
+    ``first_prev_k/v`` (batch, heads, window, dim_head) override window 0's
+    "previous window" — zeros by default (reference semantics). Sequence-
+    parallel callers pass the halo received from the neighboring shard
+    (parallel/ring_attention.py).
     """
     b, h, n, d = q.shape
     w = window_size
@@ -65,11 +72,15 @@ def local_attention(
     # queries deliberately leak softmax mass to w zero-score/zero-value keys —
     # exactly the reference behavior (progen.py:90-96). The dense golden below
     # models the same dilution.
-    def with_prev(t):
-        prev = jnp.pad(t[:, :, :-1], ((0, 0), (0, 0), (1, 0), (0, 0), (0, 0)))
+    def with_prev(t, first_prev):
+        if first_prev is None:
+            first_prev = jnp.zeros((b, h, w, d), t.dtype)
+        prev = jnp.concatenate(
+            (first_prev[:, :, None], t[:, :, :-1]), axis=2
+        )
         return jnp.concatenate((prev, t), axis=3)  # (b, h, nw, 2w, d)
 
-    kw2, vw2 = with_prev(kw), with_prev(vw)
+    kw2, vw2 = with_prev(kw, first_prev_k), with_prev(vw, first_prev_v)
 
     sim = jnp.einsum(
         "bhwid,bhwjd->bhwij", qw, kw2, preferred_element_type=jnp.float32

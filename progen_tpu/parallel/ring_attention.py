@@ -1,0 +1,83 @@
+"""Explicit sequence-parallel windowed attention via ring halo exchange.
+
+The GSPMD path (mesh ``seq`` axis + logical constraints) already handles
+sequence-sharded training automatically — see the seq-parallel parity test
+in tests/test_train.py. This module is the EXPLICIT collective formulation
+of the same computation, the windowed-attention specialization of ring
+attention: because each query window attends to at most the previous
+window, a sequence shard needs exactly ONE window of halo from its left
+neighbor, exchanged with a single ``ppermute`` hop over the ring (rides ICI
+on a TPU torus). No iteration over the ring is needed — the window
+structure collapses ring attention's S-step pipeline to one step.
+
+Per shard (inside ``shard_map`` over the ``seq`` axis):
+  1. send my LAST window's k/v to my right neighbor (ppermute, one hop);
+  2. shard 0 zeroes the received halo (window 0's "previous window" is
+     zeros in the reference semantics — progen.py:90-96);
+  3. run the standard windowed attention locally, overriding window 0's
+     previous window with the halo.
+
+Requires local_seq_len % window_size == 0 (i.e. shard boundaries align
+with window boundaries: seq_len % (S * window_size) == 0).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from progen_tpu.ops.attention import local_attention
+
+
+def ring_local_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    window_size: int,
+    mesh: Mesh,
+    seq_axis: str = "seq",
+    batch_axis: str | None = "data",
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """q, k, v: (batch, heads, n, dim_head), n sharded over ``seq_axis``
+    (batch over ``batch_axis`` when given). Returns same shape/sharding.
+    Exactly equal to ``local_attention`` on the gathered arrays."""
+    n_shards = mesh.shape[seq_axis]
+    _, _, n, _ = q.shape
+    w = window_size
+    if n % (n_shards * w) != 0:
+        raise ValueError(
+            f"seq_len {n} must divide into {n_shards} shards of whole "
+            f"{w}-token windows"
+        )
+
+    def shard_fn(q, k, v):
+        halo_k = jax.lax.ppermute(
+            k[:, :, -w:], seq_axis,
+            perm=[(i, (i + 1) % n_shards) for i in range(n_shards)],
+        )
+        halo_v = jax.lax.ppermute(
+            v[:, :, -w:], seq_axis,
+            perm=[(i, (i + 1) % n_shards) for i in range(n_shards)],
+        )
+        is_first = jax.lax.axis_index(seq_axis) == 0
+        zero = jnp.zeros((), halo_k.dtype)
+        halo_k = jnp.where(is_first, zero, halo_k)
+        halo_v = jnp.where(is_first, zero, halo_v)
+        return local_attention(
+            q, k, v,
+            window_size=w,
+            scale=scale,
+            first_prev_k=halo_k,
+            first_prev_v=halo_v,
+        )
+
+    spec = P(batch_axis, None, seq_axis, None)
+    return jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )(q, k, v)

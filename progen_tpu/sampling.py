@@ -130,6 +130,40 @@ def sample(
     )
 
 
+def sample_batched(
+    key: jax.Array,
+    model,
+    params,
+    primes: jnp.ndarray,
+    length: int,
+    top_k: Optional[int] = 25,
+    add_bos: bool = False,
+) -> jnp.ndarray:
+    """Batched decode: ``primes`` (batch, prime_len) -> (batch, length).
+
+    Each row draws its own Gumbel stream (independent fold of ``key``);
+    row i equals ``sample(fold_in(key, i), ...)`` on that prime. The
+    reference is single-sequence only (utils.py:106) — batching the decode
+    keeps the MXU busy on a mesh instead of wasting it on batch-1 matmuls.
+    """
+    primes = jnp.asarray(primes, jnp.int32)
+    if primes.ndim != 2:
+        raise ValueError(f"primes must be (batch, prime_len), got {primes.shape}")
+    batch = primes.shape[0]
+    # rectangular primes share one pad/start; validate once, pad vectorized
+    _, start = _prepare_seq(model, primes[0], length, add_bos)
+    pad = (
+        (1, length - primes.shape[1] - 1)
+        if add_bos
+        else (0, length - primes.shape[1])
+    )
+    seqs = jnp.pad(primes, ((0, 0), pad))
+    keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(jnp.arange(batch))
+    return jax.vmap(
+        lambda k, s: _decode(model, params, k, s, jnp.asarray(start), length, top_k)
+    )(keys, seqs)
+
+
 @functools.partial(jax.jit, static_argnames=("model", "length", "top_k"))
 def _decode_incremental(model, params, cache, key, seq, start_pos, length, top_k):
     """Single fused decode: prefill the cache over the prime, then one

@@ -103,6 +103,40 @@ class TestSample:
             )
 
 
+class TestBatchedSample:
+    def test_rows_match_single_decode(self, model_and_params):
+        from progen_tpu.sampling import sample_batched
+
+        model, params = model_and_params
+        primes = jnp.array([[5, 9, 11], [7, 2, 30]], jnp.int32)
+        out = np.asarray(
+            sample_batched(
+                jax.random.PRNGKey(8), model, params, primes, TINY.seq_len,
+                top_k=10, add_bos=True,
+            )
+        )
+        assert out.shape == (2, TINY.seq_len)
+        for i in range(2):
+            single = np.asarray(
+                sample(
+                    jax.random.fold_in(jax.random.PRNGKey(8), i),
+                    model, params, primes[i], TINY.seq_len,
+                    top_k=10, add_bos=True,
+                )
+            )
+            np.testing.assert_array_equal(out[i], single)
+
+    def test_rejects_1d(self, model_and_params):
+        from progen_tpu.sampling import sample_batched
+
+        model, params = model_and_params
+        with pytest.raises(ValueError):
+            sample_batched(
+                jax.random.PRNGKey(0), model, params,
+                jnp.array([1, 2], jnp.int32), TINY.seq_len,
+            )
+
+
 class TestMeshDecode:
     def test_sample_with_model_sharded_params(self, model_and_params):
         """BASELINE config 5: decode on a mesh. Shard every weight over an
