@@ -55,6 +55,13 @@ class ProGenConfig:
     # block, token-shift states, SGU gate history). Same params tree as
     # decode=False; see sampling.sample_fast.
     decode: bool = False
+    # lax.scan over the uniform (non-gMLP) transformer blocks: one traced
+    # block instead of depth-unrolled HLO — compile time and program size
+    # become O(1) in depth (matters at depth 24+). Params for those blocks
+    # gain a leading stacked 'layers' axis; models/progen.unstack_params
+    # converts to the unrolled layout (used by decode). Trailing gMLP
+    # blocks stay unrolled (different structure).
+    scan_layers: bool = False
     # NOTE: sequence parallelism is NOT a model flag — it is a property of
     # the mesh. Build the mesh with seq > 1 (partition.make_mesh) and the
     # logical rules shard the sequence axis of activations and the SGU's

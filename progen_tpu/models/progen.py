@@ -31,6 +31,68 @@ from progen_tpu.models.layers import (
 from progen_tpu.ops.rotary import fixed_pos_embedding
 
 
+class UniformBlock(nn.Module):
+    """One attention+FF residual pair — the scan body for the uniform
+    (non-gMLP) prefix of the stack when config.scan_layers is set."""
+
+    config: ProGenConfig
+    glu: bool
+
+    @nn.compact
+    def __call__(self, x, sin, cos):
+        c = self.config
+        x = x + LocalAttentionBlock(c, name="attn")(x, sin, cos, None)
+        x = x + FeedForwardBlock(c, glu=self.glu, name="ff")(x, None)
+        x = nn.with_logical_constraint(x, ("batch", "seq_act", "embed_act"))
+        return x, None
+
+
+def unstack_params(params: dict, config: ProGenConfig) -> dict:
+    """Convert a scan_layers param tree (stacked 'layers' subtree) to the
+    unrolled attn{i}/ff{i} layout — needed by decode mode (per-layer caches
+    are unrolled) and by checkpoint interchange with non-scan configs."""
+    import jax
+
+    if "layers" not in params:
+        return params
+    n_uniform = config.depth - config.global_mlp_depth
+    out = {k: v for k, v in params.items() if k != "layers"}
+    stacked = params["layers"]
+    for i in range(n_uniform):
+        out[f"attn{i}"] = jax.tree.map(lambda x: x[i], stacked["attn"])
+        out[f"ff{i}"] = jax.tree.map(lambda x: x[i], stacked["ff"])
+    return out
+
+
+def stack_params(params: dict, config: ProGenConfig) -> dict:
+    """Inverse of unstack_params: unrolled attn{i}/ff{i} -> stacked
+    'layers' subtree for a scan_layers model."""
+    import jax
+    import jax.numpy as jnp
+
+    n_uniform = config.depth - config.global_mlp_depth
+    if n_uniform < 1 or "layers" in params:
+        return params
+    out = {
+        k: v
+        for k, v in params.items()
+        if not any(
+            k == f"{p}{i}" for p in ("attn", "ff") for i in range(n_uniform)
+        )
+    }
+    out["layers"] = {
+        "attn": jax.tree.map(
+            lambda *xs: jnp.stack(xs),
+            *(params[f"attn{i}"] for i in range(n_uniform)),
+        ),
+        "ff": jax.tree.map(
+            lambda *xs: jnp.stack(xs),
+            *(params[f"ff{i}"] for i in range(n_uniform)),
+        ),
+    }
+    return out
+
+
 class ProGen(nn.Module):
     config: ProGenConfig
 
@@ -71,7 +133,23 @@ class ProGen(nn.Module):
             attn_cls = nn.remat(LocalAttentionBlock)
             ff_cls = nn.remat(FeedForwardBlock)
 
-        for i in range(c.depth):
+        n_uniform = c.depth - c.global_mlp_depth
+        if c.scan_layers and not c.decode and n_uniform > 0:
+            block_cls = nn.remat(UniformBlock) if c.remat else UniformBlock
+            scan_cls = nn.scan(
+                block_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=(nn.broadcast, nn.broadcast),
+                length=n_uniform,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )
+            x, _ = scan_cls(c, glu=c.ff_glu, name="layers")(x, sin, cos)
+            start = n_uniform
+        else:
+            start = 0
+
+        for i in range(start, c.depth):
             use_gmlp = (c.depth - i) <= c.global_mlp_depth
             use_glu = (not use_gmlp) and c.ff_glu
             x = x + attn_cls(c, name=f"attn{i}")(x, sin, cos, pos)

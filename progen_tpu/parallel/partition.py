@@ -56,6 +56,7 @@ MESH_AXES = ("data", "seq", "model")
 # readability; flax resolves each logical name independently.
 DEFAULT_RULES = (
     # --- weights ---
+    ("layers", None),  # scan_layers stacked axis (future pipeline axis)
     ("vocab", "model"),
     ("embed", None),
     ("qkv", "model"),

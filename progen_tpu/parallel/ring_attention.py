@@ -54,14 +54,13 @@ def ring_local_attention(
         )
 
     def shard_fn(q, k, v):
-        halo_k = jax.lax.ppermute(
-            k[:, :, -w:], seq_axis,
-            perm=[(i, (i + 1) % n_shards) for i in range(n_shards)],
-        )
-        halo_v = jax.lax.ppermute(
-            v[:, :, -w:], seq_axis,
-            perm=[(i, (i + 1) % n_shards) for i in range(n_shards)],
-        )
+        # NOTE: deliberately TWO ppermutes. Fusing the k/v halos into one
+        # collective (stack or concat) trips a shard_map transpose
+        # sharding-inference assertion in jax 0.9 when differentiated;
+        # XLA's collective combiner merges adjacent small ppermutes anyway.
+        perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+        halo_k = jax.lax.ppermute(k[:, :, -w:], seq_axis, perm=perm)
+        halo_v = jax.lax.ppermute(v[:, :, -w:], seq_axis, perm=perm)
         is_first = jax.lax.axis_index(seq_axis) == 0
         zero = jnp.zeros((), halo_k.dtype)
         halo_k = jnp.where(is_first, zero, halo_k)

@@ -56,9 +56,10 @@ def _gumbel_topk_step(key, logit, top_k):
 
 
 def _prepare_seq(model, prime, length, add_bos):
-    """Validate and build the fixed-shape decode buffer (shared by both
+    """Validate and build the fixed-shape decode buffer (shared by ALL
     decode paths): BOS shift (utils.py:110-111), right-padding, and the
-    bounds the model can actually serve."""
+    bounds the model can actually serve. ``prime`` may be (prime_len,) or
+    (batch, prime_len) — padding applies to the last axis either way."""
     seq_len = model.config.seq_len
     if length > seq_len:
         raise ValueError(
@@ -76,7 +77,8 @@ def _prepare_seq(model, prime, length, add_bos):
         if add_bos
         else (0, length - prime.shape[-1])
     )
-    return jnp.pad(prime, pad), start
+    widths = ((0, 0),) * (prime.ndim - 1) + (pad,)
+    return jnp.pad(prime, widths), start
 
 
 @functools.partial(jax.jit, static_argnames=("model", "length", "top_k"))
@@ -147,17 +149,12 @@ def sample_batched(
     keeps the MXU busy on a mesh instead of wasting it on batch-1 matmuls.
     """
     primes = jnp.asarray(primes, jnp.int32)
-    if primes.ndim != 2:
-        raise ValueError(f"primes must be (batch, prime_len), got {primes.shape}")
+    if primes.ndim != 2 or primes.shape[0] == 0:
+        raise ValueError(
+            f"primes must be (batch >= 1, prime_len), got {primes.shape}"
+        )
     batch = primes.shape[0]
-    # rectangular primes share one pad/start; validate once, pad vectorized
-    _, start = _prepare_seq(model, primes[0], length, add_bos)
-    pad = (
-        (1, length - primes.shape[1] - 1)
-        if add_bos
-        else (0, length - primes.shape[1])
-    )
-    seqs = jnp.pad(primes, ((0, 0), pad))
+    seqs, start = _prepare_seq(model, primes, length, add_bos)
     keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(jnp.arange(batch))
     return jax.vmap(
         lambda k, s: _decode(model, params, k, s, jnp.asarray(start), length, top_k)
@@ -215,9 +212,13 @@ def sample_fast(
     Same sampling semantics as `sample`."""
     import dataclasses
 
-    from progen_tpu.models.progen import ProGen
+    from progen_tpu.models.progen import ProGen, unstack_params
 
     dec_model = ProGen(dataclasses.replace(model.config, decode=True))
+    if model.config.scan_layers:
+        # decode mode is always unrolled (per-layer caches); convert the
+        # scanned stacked layout
+        params = unstack_params(params, model.config)
 
     seq, start = _prepare_seq(model, prime, length, add_bos)
 
