@@ -241,6 +241,7 @@ def main(
     start_step = int(jax.device_get(state.step))
     try:
       with mesh:
+        batch = next_super_batch()
         for i, seq_index in enumerate(tqdm.tqdm(seq_indices, mininterval=10)):
             if num_steps and steps_done >= num_steps:
                 break
@@ -249,8 +250,16 @@ def main(
 
                 jax_profiler.start_trace(profile_dir)
                 profiler_active = True
-            state, metrics = train_step(state, next_super_batch())
+            state, metrics = train_step(state, batch)
             steps_done += 1
+            # prepare the NEXT batch while the device is busy (async
+            # dispatch): host input pipeline overlaps device compute —
+            # skipped when this was the last step
+            is_last = (num_steps and steps_done >= num_steps) or (
+                seq_index + effective_batch >= num_train
+            )
+            if not is_last:
+                batch = next_super_batch()
             global_step = start_step + steps_done
             loss = float(metrics["last_micro_loss"])  # host sync = timing fence
             if profiler_active and i >= 4:

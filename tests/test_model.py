@@ -131,29 +131,45 @@ def test_seq_len_window_divisibility_enforced():
         ProGenConfig(seq_len=100, window_size=32)
 
 
-def test_long8k_config_shape_soundness():
-    """The long-context BASELINE config (seq 8192, window 512) must trace:
-    abstract-only (eval_shape) train step — catches any shape/window/SGU
-    wiring error at that scale without paying the FLOPs."""
-    from progen_tpu.config import load_toml_config
-    from progen_tpu.training.optimizer import make_optimizer
-    from progen_tpu.training.step import (
-        abstract_train_state,
-        make_train_step,
-    )
-
+def _trace_config(name):
+    """Shared harness: TOML -> abstract train-step trace (no FLOPs paid).
+    Returns (config, abstract_out_state, metrics, n_params)."""
     from pathlib import Path
 
-    toml = Path(__file__).parents[1] / "configs" / "model" / "long8k.toml"
+    from progen_tpu.config import load_toml_config
+    from progen_tpu.training.optimizer import make_optimizer
+    from progen_tpu.training.step import abstract_train_state, make_train_step
+
+    toml = Path(__file__).parents[1] / "configs" / "model" / f"{name}.toml"
     cfg = ProGenConfig.from_dict(load_toml_config(str(toml)))
-    assert cfg.seq_len == 8192 and cfg.window_size == 512
     model = ProGen(cfg)
     optimizer = make_optimizer()
     _, abstract = abstract_train_state(model, optimizer, cfg.seq_len)
+    n_params = sum(
+        int(np.prod(x.shape)) for x in jax.tree.leaves(abstract.params)
+    )
     step = make_train_step(model, optimizer)
     batch = jax.ShapeDtypeStruct((1, 2, cfg.seq_len + 1), jnp.int32)
     out_state, metrics = jax.eval_shape(step, abstract, batch)
     assert metrics["loss"].shape == ()
+    return cfg, out_state, metrics, n_params
+
+
+@pytest.mark.parametrize("name", ["base", "large"])
+def test_big_configs_trace(name):
+    """base (~205M) and large (~1.2B) TOMLs trace end-to-end abstractly:
+    scan_layers+remat wiring, sharding-compatible shapes, loss scalar."""
+    cfg, _, _, n_params = _trace_config(name)
+    assert cfg.scan_layers and cfg.remat
+    if name == "large":
+        assert 1.1e9 < n_params < 1.4e9, n_params
+
+
+def test_long8k_config_shape_soundness():
+    """The long-context BASELINE config (seq 8192, window 512) must trace —
+    catches any shape/window/SGU wiring error at that scale."""
+    cfg, out_state, _, _ = _trace_config("long8k")
+    assert cfg.seq_len == 8192 and cfg.window_size == 512
     # SGU spatial matrices really are (8192, 8192) on the last two layers
     sgu = out_state.params["ff11"]["sgu"]["spatial_weights"]
     assert sgu.shape == (8192, 8192)
