@@ -241,7 +241,11 @@ def main(
     start_step = int(jax.device_get(state.step))
     try:
       with mesh:
-        batch = next_super_batch()
+        # pre-fetch only when the loop will actually run: resuming a
+        # completed run (empty seq_indices) must fall through, not block
+        # on a skip-exhausted iterator
+        if len(seq_indices) > 0 and not (num_steps and num_steps <= 0):
+            batch = next_super_batch()
         for i, seq_index in enumerate(tqdm.tqdm(seq_indices, mininterval=10)):
             if num_steps and steps_done >= num_steps:
                 break

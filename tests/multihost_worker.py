@@ -1,0 +1,87 @@
+"""Worker for the 2-process multi-host integration test (test_multihost.py).
+
+Each process owns 4 virtual CPU devices (8 global), reads ITS shard of the
+tfrecord stream, assembles the global batch via put_batch
+(make_array_from_process_local_data), runs the sharded train step over a
+data=8 mesh, saves a collective checkpoint, restores it sharded, and
+prints per-step losses for the parent to compare against a single-process
+baseline.
+
+Usage: python multihost_worker.py <process_id> <data_dir> <ckpt_dir> <port>
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax
+
+process_id = int(sys.argv[1])
+data_dir, ckpt_dir, port = sys.argv[2], sys.argv[3], sys.argv[4]
+jax.distributed.initialize(
+    f"localhost:{port}", num_processes=2, process_id=process_id
+)
+
+import numpy as np
+
+from progen_tpu.checkpoint import (
+    Package,
+    get_checkpoint_fns,
+    sharded_abstract_state,
+)
+from progen_tpu.config import ProGenConfig
+from progen_tpu.data.dataset import iterator_from_tfrecords_folder
+from progen_tpu.models.progen import ProGen
+from progen_tpu.parallel.partition import make_mesh, put_batch
+from progen_tpu.training.optimizer import make_optimizer
+from progen_tpu.training.step import (
+    abstract_train_state,
+    compile_train_step,
+    init_train_state,
+)
+
+assert jax.process_count() == 2
+assert len(jax.devices()) == 8
+
+CFG = ProGenConfig(
+    num_tokens=32, dim=16, seq_len=16, depth=2, window_size=8,
+    global_mlp_depth=1, heads=2, dim_head=8, ff_mult=2, dtype="float32",
+)
+
+model = ProGen(CFG)
+optimizer = make_optimizer(1e-3)
+mesh = make_mesh(data=8, seq=1, model=1)
+state, shardings = init_train_state(
+    model, optimizer, jax.random.PRNGKey(0), CFG.seq_len, mesh=mesh
+)
+step = compile_train_step(model, optimizer, state, shardings, mesh)
+
+num_train, iter_fn = iterator_from_tfrecords_folder(data_dir)
+ds = iter_fn(
+    CFG.seq_len, batch_size=8, loop=True,
+    process_index=jax.process_index(), process_count=jax.process_count(),
+)
+
+_, get_last, save = get_checkpoint_fns(ckpt_dir)
+
+with mesh:
+    for i in range(2):
+        local = next(ds)  # (4, 17) — this process's rows of the global batch
+        batch = put_batch(local[None], mesh, accum_axis=True)
+        state, metrics = step(state, batch)
+        print(f"LOSS {i} {float(metrics['loss']):.6f}", flush=True)
+
+    save(Package(16, state, CFG.to_dict(), "mh-run"))
+
+    # sharded restore on the same mesh; continue training one more step
+    _, abstract = abstract_train_state(model, optimizer, CFG.seq_len)
+    pkg = get_last(sharded_abstract_state(abstract, shardings))
+    assert pkg.next_seq_index == 16 and pkg.run_id == "mh-run"
+    state = pkg.state
+    local = next(ds)
+    state, metrics = step(state, put_batch(local[None], mesh, accum_axis=True))
+    print(f"LOSS 2 {float(metrics['loss']):.6f}", flush=True)
+
+print("WORKER_OK", flush=True)
