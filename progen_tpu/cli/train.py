@@ -224,6 +224,23 @@ def main(
 
     import tqdm
 
+    # preemption-safe shutdown: first SIGTERM/SIGINT finishes the current
+    # step, saves a final checkpoint, and exits cleanly (preemptible TPU
+    # VMs send SIGTERM before eviction); a second signal kills immediately
+    import signal
+
+    stop_requested = {"flag": False}
+
+    def _request_stop(signum, frame):
+        if stop_requested["flag"]:
+            raise KeyboardInterrupt
+        stop_requested["flag"] = True
+        if is_coordinator():
+            print(f"signal {signum}: finishing step, then checkpoint+exit")
+
+    signal.signal(signal.SIGTERM, _request_stop)
+    signal.signal(signal.SIGINT, _request_stop)
+
     from progen_tpu import profiling
 
     timer = profiling.StepTimer(
@@ -247,6 +264,18 @@ def main(
         if len(seq_indices) > 0 and not (num_steps and num_steps <= 0):
             batch = next_super_batch()
         for i, seq_index in enumerate(tqdm.tqdm(seq_indices, mininterval=10)):
+            stop = stop_requested["flag"]
+            if jax.process_count() > 1:
+                # every host must agree before leaving the collective loop
+                # (a lone host breaking into the collective save deadlocks);
+                # reduce-max: ANY host's signal stops all hosts
+                from jax.experimental import multihost_utils
+
+                stop = bool(
+                    multihost_utils.process_allgather(np.int32(stop)).max()
+                )
+            if stop:
+                break
             if num_steps and steps_done >= num_steps:
                 break
             if profile_dir and i == 2:

@@ -173,3 +173,25 @@ def test_long8k_config_shape_soundness():
     # SGU spatial matrices really are (8192, 8192) on the last two layers
     sgu = out_state.params["ff11"]["sgu"]["spatial_weights"]
     assert sgu.shape == (8192, 8192)
+
+
+def test_reference_toml_loads_unmodified():
+    """The reference's shipped model TOML must load as-is (field-name
+    parity, /root/reference/configs/model/default.toml), and the dead
+    reference kwargs attn_dim/clamp_gate (progen.py:201-202) are ignored."""
+    from pathlib import Path
+
+    from progen_tpu.config import load_toml_config
+
+    ref_toml = Path("/root/reference/configs/model/default.toml")
+    if not ref_toml.exists():
+        pytest.skip("reference tree not mounted")
+    cfg = ProGenConfig.from_dict(load_toml_config(str(ref_toml)))
+    assert cfg.dim == 512 and cfg.depth == 6 and cfg.window_size == 512
+    assert 26e6 < cfg.num_params() < 29e6  # ~27M (SURVEY 2.1)
+
+    cfg2 = ProGenConfig.from_dict(
+        {"dim": 64, "seq_len": 64, "window_size": 32, "attn_dim": 99,
+         "clamp_gate": True}
+    )
+    assert cfg2.dim == 64  # unknown/dead keys dropped
