@@ -14,7 +14,13 @@ TPU-first deltas:
   * flax logical-axis metadata on every weight, consumed by
     progen_tpu/parallel/partition.py to lay the model over a device mesh;
   * optional per-block rematerialization (config.remat) to trade FLOPs for
-    HBM during backprop.
+    HBM during backprop;
+  * optional lax.scan over the uniform blocks (config.scan_layers) for
+    O(1)-in-depth compile;
+  * embedding init truncated_normal(stddev=0.02) — a deliberate delta from
+    hk.Embed's TruncatedNormal(stddev=1.0) default (ref progen.py:207);
+    the GPT-style small init trains more stably. Weight-transplant parity
+    tests are init-independent (tests/test_reference_parity.py).
 """
 
 from __future__ import annotations

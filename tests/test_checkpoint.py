@@ -125,3 +125,28 @@ class TestResumeEquivalence:
 
         for a, b in zip(jax.tree.leaves(straight), jax.tree.leaves(s)):
             np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+class TestPartialRestore:
+    def test_restore_params_skips_opt_state(self, setup, tmp_path):
+        """Params-only restore (sampling path) returns just the params tree
+        with correct values and matching metadata."""
+        _, _, state, _, _ = setup
+        _, get_last, save = get_checkpoint_fns(str(tmp_path / "c"))
+        save(Package(42, state, TINY.to_dict(), "rid"))
+        pkg = get_last.restore_params()
+        assert pkg.next_seq_index == 42 and pkg.run_id == "rid"
+        assert set(pkg.state.keys()) == set(state.params.keys())
+        for a, b in zip(
+            jax.tree.leaves(pkg.state), jax.tree.leaves(state.params)
+        ):
+            np.testing.assert_array_equal(a, b)
+
+    def test_peek_reads_meta_only(self, setup, tmp_path):
+        _, _, state, _, _ = setup
+        _, get_last, save = get_checkpoint_fns(str(tmp_path / "c"))
+        assert get_last.peek() is None
+        save(Package(7, state, {"dim": 32}, None))
+        pkg = get_last.peek()
+        assert pkg.next_seq_index == 7 and pkg.state is None
+        assert pkg.model_config == {"dim": 32}

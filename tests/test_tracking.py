@@ -1,0 +1,58 @@
+"""Tracker backends: jsonl metrics/html/config, noop, factory gating."""
+
+import json
+
+from progen_tpu.tracking import (
+    JsonlTracker,
+    NoopTracker,
+    make_tracker,
+    render_sample_html,
+)
+
+
+class TestJsonlTracker:
+    def test_metrics_and_step(self, tmp_path):
+        t = JsonlTracker("proj", run_id=None, dir=str(tmp_path))
+        assert t.run_id  # generated
+        t.log({"loss": 1.5}, step=3)
+        t.log({"loss": 1.2, "mfu": 0.4}, step=4)
+        t.finish()
+        rows = [
+            json.loads(l)
+            for l in (tmp_path / "proj" / t.run_id / "metrics.jsonl")
+            .read_text()
+            .splitlines()
+        ]
+        assert rows[0]["loss"] == 1.5 and rows[0]["_step"] == 3
+        assert rows[1]["mfu"] == 0.4
+
+    def test_resume_appends(self, tmp_path):
+        t1 = JsonlTracker("p", "run1", dir=str(tmp_path))
+        t1.log({"loss": 2.0}, step=1)
+        t1.finish()
+        t2 = JsonlTracker("p", "run1", dir=str(tmp_path))  # resume same id
+        t2.log({"loss": 1.0}, step=2)
+        t2.finish()
+        lines = (tmp_path / "p" / "run1" / "metrics.jsonl").read_text()
+        assert len(lines.splitlines()) == 2
+
+    def test_html_and_config(self, tmp_path):
+        t = JsonlTracker("p", "r", dir=str(tmp_path))
+        html = render_sample_html("[tax=X] #", "MGHK")
+        assert "<i>[tax=X] #</i>" in html and "MGHK" in html
+        t.log_html("samples", html, step=7)
+        t.set_config({"dim": 512})
+        d = tmp_path / "p" / "r"
+        assert (d / "samples_7.html").read_text() == html
+        assert json.loads((d / "config.json").read_text())["dim"] == 512
+
+
+class TestFactory:
+    def test_disabled_gives_noop(self):
+        assert isinstance(make_tracker("p", disabled=True), NoopTracker)
+
+    def test_default_gives_jsonl_without_wandb(self, tmp_path):
+        t = make_tracker("p", dir=str(tmp_path))
+        # wandb is absent in this image -> jsonl backend
+        assert isinstance(t, JsonlTracker)
+        t.finish()
