@@ -1,0 +1,114 @@
+"""GPipe-style pipeline parallelism over a stacked layer axis.
+
+The reference has no pipeline parallelism (SURVEY §2.5: PP "NO"); this is
+the TPU-native formulation for when a model's layers outgrow one chip's
+HBM even after TP: the ``scan_layers`` stacked parameter axis (L, ...) is
+sharded over a mesh axis into P stages of L/P layers, and microbatches
+flow through the stages with a rotating ``ppermute`` schedule.
+
+Schedule (classic GPipe, M microbatches, P stages, M+P-1 ticks):
+
+  tick t: stage p runs microbatch (t - p) through its local layers when
+  0 <= t-p < M — stage 0 injects microbatch t from the input, every other
+  stage consumes the activation its left neighbor sent last tick; after
+  computing, every stage sends its activation one hop right. The first
+  P-1 and last P-1 ticks are the pipeline bubble.
+
+Differentiable end-to-end: the backward pass is jax's transpose of the
+scan-of-ppermute (activations flow left, cotangents flow right). The backward
+schedule is the autodiff TRANSPOSE of GPipe — all forwards then all
+backwards, so activations for all M microbatches stay live until the
+backward sweep (O(M) activation memory, not 1F1B's O(P)); pair with
+remat on the block_fn when that matters.
+
+This module is deliberately a standalone op + tests (like
+parallel/ring_attention.py): the production train step covers dp/tp/sp via
+GSPMD; pipeline_apply is the building block for depth-sharded deployments.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_apply(
+    block_fn: Callable,
+    stacked_params,
+    x: jnp.ndarray,
+    *,
+    mesh: Mesh,
+    axis: str,
+    n_microbatches: int,
+) -> jnp.ndarray:
+    """Run L stacked layers as a P-stage pipeline over microbatches.
+
+    block_fn(params_one_layer, x) -> x : one layer's forward.
+    stacked_params: pytree with leading axis L on every leaf (the
+      scan_layers layout), sharded/split over mesh axis ``axis`` (P stages,
+      L % P == 0 — each stage owns L/P consecutive layers).
+    x: (B, ...) global batch, B % n_microbatches == 0.
+
+    Returns block-sequential-equivalent output (B, ...).
+    """
+    n_stages = mesh.shape[axis]
+    L = jax.tree.leaves(stacked_params)[0].shape[0]
+    if L % n_stages:
+        raise ValueError(f"{L} layers not divisible by {n_stages} stages")
+    B = x.shape[0]
+    M = n_microbatches
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    mb = B // M
+    x_mb = x.reshape((M, mb) + x.shape[1:])
+
+    def stage_fn(local_params, x_mb):
+        # local_params leaves: (L/P, ...); x_mb replicated (M, mb, ...)
+        p = jax.lax.axis_index(axis)
+        T = M + n_stages - 1
+
+        def local_layers(h):
+            def body(h, layer_params):
+                return block_fn(layer_params, h), None
+
+            h, _ = jax.lax.scan(body, h, local_params)
+            return h
+
+        def tick(carry, t):
+            left_buf = carry  # activation received from the left neighbor
+            mb_idx = jnp.clip(t - p, 0, M - 1)
+            inject = jax.lax.dynamic_index_in_dim(
+                x_mb, mb_idx, axis=0, keepdims=False
+            )
+            h = jnp.where(p == 0, inject, left_buf)
+            out = local_layers(h)
+            # rotate one hop right for the next tick
+            left_buf = jax.lax.ppermute(
+                out, axis,
+                perm=[(i, (i + 1) % n_stages) for i in range(n_stages)],
+            )
+            return left_buf, out
+
+        # carry must be marked device-varying over the pipeline axis (jax
+        # 0.9 varying-manual-axes typing for scan-of-ppermute)
+        init = jax.lax.pcast(
+            jnp.zeros_like(x_mb[0]), (axis,), to="varying"
+        )
+        _, outs = jax.lax.scan(tick, init, jnp.arange(T))
+        # the LAST stage's outputs at ticks P-1 .. P-1+M-1 are the finished
+        # microbatches; other stages' rows are bubble garbage that the
+        # (P, ...)-stacked out_spec lets the caller discard
+        return outs[None]  # (1, T, mb, ...) -> stage-stacked by out_spec
+
+    outs = jax.shard_map(
+        stage_fn,
+        mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=P(axis),
+    )(stacked_params, x_mb)
+    # outs: (P, T, mb, ...); finished microbatches live on the last stage
+    final = outs[n_stages - 1, n_stages - 1 : n_stages - 1 + M]
+    return final.reshape((B,) + x.shape[1:])
