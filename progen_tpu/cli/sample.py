@@ -35,12 +35,18 @@ import jax
     is_flag=True,
     help="reference-style full forward per token instead of the KV cache",
 )
-def main(seed, checkpoint_path, prime, top_k, naive):
+@click.option(
+    "--num_samples",
+    default=1,
+    help="decode this many sequences from the prime in one batched pass "
+    "(batched mode always uses the full-forward decode; --naive is moot)",
+)
+def main(seed, checkpoint_path, prime, top_k, naive, num_samples):
     from progen_tpu.checkpoint import get_checkpoint_fns
     from progen_tpu.config import ProGenConfig
     from progen_tpu.data.tokenizer import decode_tokens, encode_tokens
     from progen_tpu.models.progen import ProGen
-    from progen_tpu.sampling import sample, sample_fast
+    from progen_tpu.sampling import sample, sample_batched, sample_fast
 
     _, get_last, _ = get_checkpoint_fns(checkpoint_path)
     # params-only restore: sampling never needs the optimizer moments
@@ -59,6 +65,17 @@ def main(seed, checkpoint_path, prime, top_k, naive):
 
     prime_tokens = np.asarray(encode_tokens(prime), dtype=np.int32)
     prime_length = len(prime_tokens) + 1  # +1 for BOS (sample.py:67)
+
+    if num_samples > 1:
+        primes = np.tile(prime_tokens, (num_samples, 1))
+        sampled = sample_batched(
+            jax.random.PRNGKey(seed), model, params, primes,
+            config.seq_len, top_k=top_k, add_bos=True,
+        )
+        print("\n", prime, "\n", "*" * 40)
+        for row in np.asarray(sampled):
+            print(decode_tokens(row[prime_length:]), "\n", "-" * 40)
+        return
 
     sample_fn = sample if naive else sample_fast
     sampled = sample_fn(

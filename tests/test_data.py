@@ -249,3 +249,22 @@ class TestFastaETL:
         for r in rows:
             text = decode_tokens(r)
             assert "#" in text
+
+
+class TestResumeContracts:
+    def test_skip_independent_of_batch_size(self, tmp_path):
+        """README.md:112 (reference): resume stays correct across
+        batch-size changes because `skip` counts RECORDS, not batches."""
+        seqs = _write_shards(tmp_path)
+        _, iter_fn = iterator_from_tfrecords_folder(str(tmp_path))
+        rows_bs4 = [
+            decode_tokens(r)
+            for b in iter_fn(seq_len=16, batch_size=4, skip=6)
+            for r in b
+        ]
+        rows_bs3 = [
+            decode_tokens(r)
+            for b in iter_fn(seq_len=16, batch_size=3, skip=6)
+            for r in b
+        ]
+        assert rows_bs4 == rows_bs3 == [s.decode() for s in seqs[6:]]

@@ -1,0 +1,96 @@
+"""gs:// code paths exercised against an in-memory fake google.cloud.storage
+(no network): dataset glob prefix anchoring and ETL shard upload naming."""
+
+import sys
+import types
+
+import pytest
+
+
+class _FakeBlob:
+    def __init__(self, bucket, name):
+        self.bucket = bucket
+        self.name = name
+
+    def upload_from_filename(self, path, timeout=None):
+        with open(path, "rb") as f:
+            self.bucket.files[self.name] = f.read()
+
+
+class _FakeBucket:
+    def __init__(self, name):
+        self.name = name
+        self.files = {}
+
+    def blob(self, name):
+        return _FakeBlob(self, name)
+
+
+class _FakeClient:
+    buckets = {}
+
+    def get_bucket(self, name):
+        return self.buckets.setdefault(name, _FakeBucket(name))
+
+    def list_blobs(self, bucket_name, prefix=None):
+        bucket = self.buckets.setdefault(bucket_name, _FakeBucket(bucket_name))
+        for name in sorted(bucket.files):
+            if prefix is None or name.startswith(prefix):
+                yield types.SimpleNamespace(name=name)
+
+
+@pytest.fixture
+def fake_gcs(monkeypatch):
+    _FakeClient.buckets = {}
+    storage = types.SimpleNamespace(Client=_FakeClient)
+    google_cloud = types.ModuleType("google.cloud")
+    google_cloud.storage = storage
+    monkeypatch.setitem(sys.modules, "google.cloud", google_cloud)
+    monkeypatch.setitem(
+        sys.modules, "google.cloud.storage", types.ModuleType("storage")
+    )
+    sys.modules["google.cloud.storage"].Client = _FakeClient
+    return _FakeClient()
+
+
+class TestGcsGlob:
+    def test_prefix_anchored_to_directory(self, fake_gcs):
+        from progen_tpu.data.dataset import _gcs_glob
+
+        b = fake_gcs.get_bucket("bkt")
+        b.files["run1/0.5.train.tfrecord.gz"] = b""
+        b.files["run10/0.9.train.tfrecord.gz"] = b""  # must NOT leak in
+        b.files["run1/0.2.valid.tfrecord.gz"] = b""
+        names = _gcs_glob("gs://bkt/run1", "train")
+        assert names == ["gs://bkt/run1/0.5.train.tfrecord.gz"]
+
+
+class TestGcsEtlUpload:
+    def test_shards_upload_with_contract_names(self, fake_gcs, tmp_path):
+        import glob
+        import tempfile
+
+        from progen_tpu.data.fasta import write_tfrecord_shards
+
+        staging_glob = str(
+            __import__("pathlib").Path(tempfile.gettempdir())
+            / "tfrecord_staging_*"
+        )
+        before = set(glob.glob(staging_glob))
+        seqs = [f"# SEQ{i}".encode() for i in range(10)]
+        written = write_tfrecord_shards(
+            seqs,
+            "gs://bkt/data",
+            fraction_valid_data=0.2,
+            num_sequences_per_file=4,
+            seed=0,
+        )
+        bucket = fake_gcs.get_bucket("bkt")
+        assert all(w.startswith("gs://bkt/data/") for w in written)
+        # filename count contract holds on the uploaded names
+        from progen_tpu.data.dataset import count_from_filename
+
+        total = sum(count_from_filename(n) for n in bucket.files)
+        assert total == 10
+        # staging dir cleaned up (only dirs created by THIS call counted)
+        assert set(glob.glob(staging_glob)) == before

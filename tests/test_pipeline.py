@@ -76,9 +76,9 @@ class TestPipelineMlp:
                            n_microbatches=2)
         with pytest.raises(ValueError):
             pipeline_apply(
-                _mlp_stack(0, 8, 8), _mlp_stack(0, 8, 8)["w"][:0], x,
+                _mlp_block, _mlp_stack(0, 8, 8), x,
                 mesh=mesh, axis="model", n_microbatches=3,
-            )  # batch 4 % 3 != 0
+            )  # batch 4 % 3 != 0 (layers/stages otherwise valid)
 
 
 class TestPipelineProGenBlocks:
