@@ -158,14 +158,10 @@ class TestCrossTopologyRestore:
         (8, 1, 1) data-parallel mesh: every leaf lands on the new mesh's
         shardings with identical values (elastic re-topology — impossible
         with the reference's single-host pickle)."""
-        from jax.sharding import PartitionSpec as P
-
         from progen_tpu.checkpoint import sharded_abstract_state
         from progen_tpu.parallel.partition import make_mesh, state_shardings
-        from progen_tpu.training.step import init_train_state
 
-        model = ProGen(TINY)
-        optimizer = make_optimizer(learning_rate=1e-3)
+        model, optimizer, *_ = setup
 
         mesh_a = make_mesh(data=2, seq=1, model=4)
         state_a, _ = init_train_state(
