@@ -26,6 +26,8 @@ jax.distributed.initialize(
 
 import numpy as np
 
+import jax.experimental.multihost_utils  # used by the TP phase allgather
+
 from progen_tpu.checkpoint import (
     Package,
     get_checkpoint_fns,
@@ -83,5 +85,31 @@ with mesh:
     local = next(ds)
     state, metrics = step(state, put_batch(local[None], mesh, accum_axis=True))
     print(f"LOSS 2 {float(metrics['loss']):.6f}", flush=True)
+
+# --- phase 2: tensor parallelism ACROSS hosts — the model axis spans both
+# processes, so every attention/FF block's all-reduce crosses the process
+# boundary (Gloo here; ICI/DCN on real TPU)
+mesh_tp = make_mesh(data=1, seq=1, model=8)
+state_tp, shardings_tp = init_train_state(
+    model, optimizer, jax.random.PRNGKey(0), CFG.seq_len, mesh=mesh_tp
+)
+step_tp = compile_train_step(model, optimizer, state_tp, shardings_tp, mesh_tp)
+ds_tp = iter_fn(
+    CFG.seq_len, batch_size=8, loop=True, skip=0,
+    process_index=jax.process_index(), process_count=jax.process_count(),
+)
+with mesh_tp:
+    local = next(ds_tp)
+    # batch replicated on a pure-TP mesh (data axis size 1): every host
+    # must feed the IDENTICAL global batch — allgather the dealt rows and
+    # re-interleave by global record index (row g came from process g%2)
+    per_proc = jax.experimental.multihost_utils.process_allgather(local)
+    both = np.zeros((8, CFG.seq_len + 1), np.int32)
+    both[0::2] = per_proc[0]
+    both[1::2] = per_proc[1]
+    state_tp, metrics_tp = step_tp(
+        state_tp, put_batch(both[None], mesh_tp, accum_axis=True)
+    )
+    print(f"LOSS_TP {float(metrics_tp['loss']):.6f}", flush=True)
 
 print("WORKER_OK", flush=True)

@@ -75,12 +75,26 @@ def test_two_process_training_matches_single(tmp_path):
         return [
             float(line.split()[2])
             for line in text.splitlines()
-            if line.startswith("LOSS")
+            if line.startswith("LOSS ")
         ]
 
     l0, l1 = losses(outs[0]), losses(outs[1])
     assert len(l0) == 3
     np.testing.assert_allclose(l0, l1, rtol=1e-6)
+
+    # cross-host TENSOR-parallel phase: model axis spans both processes
+    # (every block's all-reduce crosses hosts); same first batch and same
+    # fresh init as step 0 of the DP phase -> identical loss
+    def tp_loss(text):
+        return [
+            float(line.split()[1])
+            for line in text.splitlines()
+            if line.startswith("LOSS_TP")
+        ]
+
+    (tp0,), (tp1,) = tp_loss(outs[0]), tp_loss(outs[1])
+    np.testing.assert_allclose(tp0, tp1, rtol=1e-6)
+    np.testing.assert_allclose(tp0, l0[0], rtol=1e-5)
 
     # single-process baseline on the SAME global batches (the loss is a
     # mean over the batch — row order from record dealing is irrelevant)
