@@ -15,8 +15,6 @@ scale-only (create_offset=False in the reference, progen.py:22).
 
 from __future__ import annotations
 
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
