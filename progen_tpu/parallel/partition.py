@@ -72,25 +72,92 @@ DEFAULT_RULES = (
 )
 
 
+# device files whose presence marks a TPU VM (tests monkeypatch this)
+_TPU_DEV_PATHS = ("/dev/accel0", "/dev/vfio/0")
+
+
+def _tpu_pod_worker_count() -> int:
+    """Worker count from the TPU runtime env (GKE sets
+    ``TPU_WORKER_HOSTNAMES`` as a comma list on every pod worker; single
+    hosts carry one entry or none)."""
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    return len([h for h in hosts.split(",") if h.strip()])
+
+
 def initialize_distributed() -> None:
     """Bootstrap multi-process JAX when launched under a multi-host runtime.
 
-    Safe to call unconditionally: no-ops when single-process (no coordinator
-    address configured) or when already initialized. Must run before any
-    backend query — even ``jax.process_count()`` initializes backends, after
-    which ``jax.distributed.initialize()`` raises — so the guards here only
-    touch env/config state.
+    Safe to call unconditionally; must run before any backend query — even
+    ``jax.process_count()`` initializes backends, after which
+    ``jax.distributed.initialize()`` raises — so the guards below only touch
+    env/config state. Decision matrix:
+
+      1. already initialized                      -> no-op.
+      2. ``JAX_COORDINATOR_ADDRESS`` /
+         ``COORDINATOR_ADDRESS`` set              -> initialize (explicit
+         path: the Gloo CPU tests, manual launches, schedulers that export
+         the coordinator themselves).
+      3. ``TPU_WORKER_HOSTNAMES`` lists >1 host   -> initialize via JAX's
+         cluster auto-detect (GKE TPU pod). Failure here RAISES — a pod
+         launch silently degrading to N independent single-process jobs is
+         the worst outcome, per v5e pod postmortems.
+      4. TPU device files present and metadata
+         queries not disabled (``TPU_SKIP_MDS_QUERY``) -> best-effort
+         auto-detect (GCE TPU VM, where only the metadata server knows the
+         topology: jax's GceTpuCluster queries it with no env var set).
+         A single host initializes as 1 process, which is harmless; an
+         undetectable cluster raises inside jax and is re-raised when the
+         host looks multi-worker, swallowed otherwise.
+      5. anything else (CPU hosts, the single-chip relay) -> no-op.
     """
-    addr = os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
-        "COORDINATOR_ADDRESS"
-    )
-    if not addr:
-        return
     from jax._src import distributed as _dist
 
     if _dist.global_state.coordinator_address is not None:
         return  # already initialized
-    jax.distributed.initialize()
+
+    explicit = os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
+        "COORDINATOR_ADDRESS"
+    )
+    if explicit:
+        jax.distributed.initialize()
+        return
+
+    workers = _tpu_pod_worker_count()
+    if workers > 1:
+        try:
+            jax.distributed.initialize()
+        except Exception as e:  # noqa: BLE001 — converted into a loud abort
+            raise RuntimeError(
+                f"TPU_WORKER_HOSTNAMES lists {workers} workers but "
+                "jax.distributed.initialize() failed; refusing to run as "
+                f"{workers} independent single-process jobs"
+            ) from e
+        return
+
+    metadata_ok = os.environ.get("TPU_SKIP_MDS_QUERY") != "1"
+    has_tpu_dev = any(os.path.exists(p) for p in _TPU_DEV_PATHS)
+    if metadata_ok and has_tpu_dev:
+        try:
+            jax.distributed.initialize()
+        except Exception as e:  # noqa: BLE001
+            if os.environ.get("TPU_WORKER_ID"):
+                # a pod runtime set a worker id: this host IS part of a
+                # multi-worker slice, so a detect failure must not degrade
+                # to independent single-process jobs
+                raise RuntimeError(
+                    "TPU_WORKER_ID is set (pod worker) but "
+                    "jax.distributed.initialize() failed"
+                ) from e
+            # no multi-worker evidence: a bare single-host TPU VM outside
+            # GCE — single-process is correct, but say so in case this IS
+            # a slice whose metadata server was transiently unreachable
+            import sys
+
+            print(
+                "initialize_distributed: TPU present but no cluster "
+                f"detected ({type(e).__name__}); continuing single-process",
+                file=sys.stderr,
+            )
 
 
 def is_coordinator() -> bool:

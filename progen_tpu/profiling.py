@@ -33,10 +33,25 @@ _DEFAULT_PEAK = 197e12  # v5e
 
 
 def flops_per_token(config) -> int:
-    """Training FLOPs per token (fwd+bwd), PaLM MFU convention."""
+    """Training FLOPs per token (fwd+bwd), PaLM MFU convention.
+
+    The SGU's ``(n, n)`` spatial matrix is the one place the ``6*params``
+    convention breaks: a per-sequence weight does ``2*n*d_half`` fwd flops
+    per *token* (each output token mixes n sequence positions of a
+    d_half-wide activation), not the ``2*n*n`` the convention would charge.
+    They coincide only when ``d_half == n`` (the default config's
+    1024/1024); at long context (n=8192, d_half=1024) the params convention
+    overstates the SGU term 8x. So: charge ``6*(params - spatial)`` for the
+    dense math and ``6*n*d_half`` per gMLP layer for the spatial mix.
+    """
     attn_ctx = 2 * config.window_size
+    n = config.seq_len
+    d_half = (config.ff_mult * config.dim) // 2
+    n_gmlp = min(config.global_mlp_depth, config.depth)
+    spatial_params = n_gmlp * n * n
     return (
-        6 * config.num_params()
+        6 * (config.num_params() - spatial_params)
+        + n_gmlp * 6 * n * d_half
         + 12 * config.depth * config.heads * config.dim_head * attn_ctx
     )
 

@@ -197,6 +197,29 @@ def _decode_incremental(model, params, cache, key, seq, start_pos, length, top_k
     return seq * (~after_eos)
 
 
+@functools.lru_cache(maxsize=8)
+def _cache_init_fn(model, sharding):
+    """Compiled zeroed-cache builder, cached on (model, sharding) so a
+    train loop's cadenced samples re-EXECUTE it (fresh cache arrays) without
+    re-TRACING it every cadence. ``sharding`` is the params' mesh sharding,
+    replicated: in multi-process runs a bare jit would commit the cache to
+    each process's local device, which cannot be mixed with globally-sharded
+    params inside `_decode_incremental` (incompatible-devices error at the
+    first cadenced sample). Shardings and flax modules both hash by value,
+    so the cache key is stable across calls."""
+    out_shardings = None
+    if sharding is not None and getattr(sharding, "mesh", None) is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        out_shardings = NamedSharding(sharding.mesh, PartitionSpec())
+    return jax.jit(
+        lambda: model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 1), jnp.int32)
+        )["cache"],
+        out_shardings=out_shardings,
+    )
+
+
 def sample_fast(
     key: jax.Array,
     model,
@@ -223,12 +246,22 @@ def sample_fast(
     seq, start = _prepare_seq(model, prime, length, add_bos)
 
     # cache skeleton: params creation inside init is dead-code-eliminated
-    # under jit since only the cache collection is returned
-    cache = jax.jit(
-        lambda: dec_model.init(
-            jax.random.PRNGKey(0), jnp.zeros((1, 1), jnp.int32)
-        )["cache"]
-    )()
+    # under jit since only the cache collection is returned. Replicated on
+    # the params' mesh (see _cache_init_fn) and trace-cached across calls.
+    param_leaf = next(
+        (
+            l
+            for l in jax.tree.leaves(params)
+            if isinstance(l, jax.Array)
+        ),
+        None,
+    )
+    sharding = param_leaf.sharding if param_leaf is not None else None
+    try:
+        init_fn = _cache_init_fn(dec_model, sharding)
+    except TypeError:  # unhashable sharding: fall back to uncached
+        init_fn = _cache_init_fn.__wrapped__(dec_model, sharding)
+    cache = init_fn()
     return _decode_incremental(
         dec_model, params, cache, key, seq, jnp.asarray(start), length, top_k
     )

@@ -13,8 +13,19 @@ from pathlib import Path
 
 def load_env_file(path: str = ".env") -> dict:
     """Load KEY=VALUE pairs into os.environ (existing keys win). Returns
-    the parsed mapping; missing file -> empty dict, like load_dotenv."""
+    the parsed mapping; missing file -> empty dict, like load_dotenv.
+
+    A relative ``path`` not found in the CWD is searched for UPWARD through
+    parent directories (dotenv's find_dotenv behavior) — so running a CLI
+    from a project subdirectory still picks up the project's ``.env``.
+    """
     p = Path(path)
+    if not p.is_absolute() and not p.exists():
+        for parent in Path.cwd().resolve().parents:
+            candidate = parent / path
+            if candidate.exists():
+                p = candidate
+                break
     if not p.exists():
         return {}
     parsed = {}

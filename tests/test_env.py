@@ -1,0 +1,69 @@
+"""`.env` loader + the shipped default `.env`."""
+
+import os
+from pathlib import Path
+
+from progen_tpu.utils.env import load_env_file
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+class TestLoader:
+    def test_parse_and_precedence(self, tmp_path, monkeypatch):
+        f = tmp_path / ".env"
+        f.write_text(
+            "# comment\n"
+            "export FOO=bar\n"
+            "QUOTED='a b c'\n"
+            "INLINE=x # trailing comment\n"
+            "WINS=dotenv\n"
+        )
+        monkeypatch.setenv("WINS", "environ")
+        saved = dict(os.environ)
+        try:
+            parsed = load_env_file(str(f))
+            assert parsed["FOO"] == "bar" and os.environ["FOO"] == "bar"
+            assert parsed["QUOTED"] == "a b c"
+            assert parsed["INLINE"] == "x"
+            # existing environment wins (dotenv override=False semantics)
+            assert os.environ["WINS"] == "environ"
+        finally:  # loader writes via setdefault: restore ALL keys it added
+            os.environ.clear()
+            os.environ.update(saved)
+
+    def test_missing_file(self):
+        assert load_env_file("/nonexistent/.env") == {}
+
+    def test_upward_search(self, tmp_path, monkeypatch):
+        (tmp_path / ".env").write_text("UPWARD_FOUND=yes\n")
+        sub = tmp_path / "a" / "b"
+        sub.mkdir(parents=True)
+        monkeypatch.chdir(sub)
+        saved = dict(os.environ)
+        try:
+            assert load_env_file()["UPWARD_FOUND"] == "yes"
+        finally:
+            os.environ.clear()
+            os.environ.update(saved)
+
+
+class TestShippedDefaultEnv:
+    def test_exists_and_parses(self, monkeypatch):
+        # parse WITHOUT mutating this process's environment
+        env_path = REPO_ROOT / ".env"
+        assert env_path.exists()
+        saved = dict(os.environ)
+        try:
+            parsed = load_env_file(str(env_path))
+        finally:
+            os.environ.clear()
+            os.environ.update(saved)
+        assert parsed  # non-empty
+
+        # TPU-only --xla_tpu_* names are FATAL inside XLA_FLAGS on CPU-only
+        # hosts (parse_flags_from_env aborts the process) — they must ride
+        # LIBTPU_INIT_ARGS instead. Regression-pin that invariant.
+        assert "xla_tpu" not in parsed.get("XLA_FLAGS", "")
+        assert "--xla_tpu_enable_async_collective_fusion" in parsed.get(
+            "LIBTPU_INIT_ARGS", ""
+        )

@@ -122,3 +122,100 @@ class TestLogicalCoverage:
         )
         ruled = {name for name, _ in DEFAULT_RULES}
         assert used <= ruled, f"unruled logical axes: {used - ruled}"
+
+
+class TestInitializeDistributed:
+    """Decision-matrix tests for the pod bootstrap (the real initialize is
+    monkeypatched out: this suite runs single-process, already-initialized
+    backends would make a real call raise)."""
+
+    def _run(self, monkeypatch, env, init_behavior, tpu_dev=False,
+             tmp_path=None):
+        from progen_tpu.parallel import partition
+
+        for k in (
+            "JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+            "TPU_WORKER_HOSTNAMES", "TPU_SKIP_MDS_QUERY", "TPU_WORKER_ID",
+        ):
+            monkeypatch.delenv(k, raising=False)
+        for k, v in env.items():
+            monkeypatch.setenv(k, v)
+        # pin the device-file probe so the suite behaves identically on CPU
+        # hosts AND real TPU VMs (where /dev/accel0 exists)
+        if tpu_dev:
+            dev = tmp_path / "accel0"
+            dev.write_text("")
+            monkeypatch.setattr(partition, "_TPU_DEV_PATHS", (str(dev),))
+        else:
+            monkeypatch.setattr(partition, "_TPU_DEV_PATHS", ())
+
+        calls = []
+
+        def fake_init(*a, **kw):
+            calls.append(1)
+            if init_behavior == "raise":
+                raise ValueError("no cluster detected")
+
+        monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+        # pretend not yet initialized even though the suite's backend is up
+        from jax._src import distributed as _dist
+
+        monkeypatch.setattr(
+            _dist.global_state, "coordinator_address", None
+        )
+        partition.initialize_distributed()
+        return len(calls)
+
+    def test_explicit_env_path(self, monkeypatch):
+        n = self._run(
+            monkeypatch, {"JAX_COORDINATOR_ADDRESS": "localhost:1234"}, "ok"
+        )
+        assert n == 1
+
+    def test_gke_pod_initializes(self, monkeypatch):
+        n = self._run(
+            monkeypatch, {"TPU_WORKER_HOSTNAMES": "w0,w1,w2,w3"}, "ok"
+        )
+        assert n == 1
+
+    def test_gke_pod_failure_is_loud(self, monkeypatch):
+        with pytest.raises(RuntimeError, match="4 workers"):
+            self._run(
+                monkeypatch,
+                {"TPU_WORKER_HOSTNAMES": "w0,w1,w2,w3"},
+                "raise",
+            )
+
+    def test_single_host_relay_is_noop(self, monkeypatch):
+        # this build environment: one worker entry + metadata disabled
+        n = self._run(
+            monkeypatch,
+            {"TPU_WORKER_HOSTNAMES": "localhost",
+             "TPU_SKIP_MDS_QUERY": "1"},
+            "ok",
+        )
+        assert n == 0
+
+    def test_cpu_host_is_noop(self, monkeypatch):
+        assert self._run(monkeypatch, {}, "ok") == 0
+
+    def test_gce_tpu_vm_attempts_autodetect(self, monkeypatch, tmp_path):
+        # branch 4: TPU device present, metadata queries allowed -> attempt
+        n = self._run(monkeypatch, {}, "ok", tpu_dev=True,
+                      tmp_path=tmp_path)
+        assert n == 1
+
+    def test_gce_single_host_failure_swallowed(self, monkeypatch, tmp_path,
+                                               capsys):
+        # no multi-worker evidence: detect failure degrades to
+        # single-process WITH a stderr note, not silently
+        n = self._run(monkeypatch, {}, "raise", tpu_dev=True,
+                      tmp_path=tmp_path)
+        assert n == 1
+        assert "single-process" in capsys.readouterr().err
+
+    def test_gce_pod_worker_failure_is_loud(self, monkeypatch, tmp_path):
+        # TPU_WORKER_ID set = pod runtime: failure must raise
+        with pytest.raises(RuntimeError, match="TPU_WORKER_ID"):
+            self._run(monkeypatch, {"TPU_WORKER_ID": "3"}, "raise",
+                      tpu_dev=True, tmp_path=tmp_path)

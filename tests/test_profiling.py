@@ -18,6 +18,34 @@ class TestFlops:
         # dominated by 6N
         assert profiling.flops_per_token(small) > 6 * small.num_params()
 
+    def test_flops_long8k_sgu_not_params_convention(self):
+        # At n=8192 the (n, n) spatial matrices must be charged by their
+        # actual per-token work (6*n*d_half), not 6*params (6*n*n) — the
+        # params convention overstates the SGU term n/d_half = 8x here.
+        cfg = ProGenConfig(
+            dim=512, depth=12, heads=8, dim_head=64,
+            window_size=512, seq_len=8192, global_mlp_depth=2,
+        )
+        n, d_half = 8192, (4 * 512) // 2  # 1024
+        dense = 6 * (cfg.num_params() - 2 * n * n)
+        sgu = 2 * 6 * n * d_half
+        attn = 12 * cfg.depth * cfg.heads * cfg.dim_head * (2 * 512)
+        assert profiling.flops_per_token(cfg) == dense + sgu + attn
+        # the old 6*num_params accounting was exactly 6*n*(n - d_half)
+        # per gMLP layer too high
+        old = 6 * cfg.num_params() + attn
+        assert old - profiling.flops_per_token(cfg) == 2 * 6 * n * (n - d_half)
+
+    def test_flops_default_config_coincides_with_params_convention(self):
+        # default: n=1024 == d_half=1024, so the corrected formula equals
+        # the plain 6*num_params convention — the tiny/default numbers in
+        # prior BENCH records are unchanged by the fix
+        cfg = ProGenConfig()
+        attn = 12 * cfg.depth * cfg.heads * cfg.dim_head * (
+            2 * cfg.window_size
+        )
+        assert profiling.flops_per_token(cfg) == 6 * cfg.num_params() + attn
+
     def test_peak_flops_default(self):
         class Dev:
             device_kind = "unknown thing"
