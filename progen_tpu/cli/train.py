@@ -62,6 +62,13 @@ def confirm(question: str) -> bool:
 @click.option("--profile_dir", default="", help="jax.profiler trace dir for steps 2-4")
 @click.option("--hardware_rng", default=False, is_flag=True,
               help="TPU-fast partitionable rbg PRNG (ref: set_hardware_rng_)")
+@click.option("--naive_sample", default=False, is_flag=True,
+              help="cadenced samples via the full-forward-per-token decoder "
+                   "(reference parity path) instead of the KV-cache decode")
+@click.option("--ring_attn", default=False, is_flag=True,
+              help="explicit ring halo-exchange attention over the seq mesh "
+                   "axis (requires --mesh_seq > 1) instead of GSPMD-inferred "
+                   "collectives")
 def main(
     seed,
     batch_size,
@@ -90,6 +97,8 @@ def main(
     num_steps,
     profile_dir,
     hardware_rng,
+    naive_sample,
+    ring_attn,
 ):
     from progen_tpu.checkpoint import Package, get_checkpoint_fns
     from progen_tpu.config import ProGenConfig, load_toml_config
@@ -102,7 +111,13 @@ def main(
         make_mesh,
         put_batch,
     )
-    from progen_tpu.sampling import sample as sample_tokens
+    # KV-cache decode by default: O(2w*d) attention per emitted token, so a
+    # cadenced sample costs seconds, not (at long context) thousands of full
+    # forwards blocking the train loop. Bit-identical to the naive path
+    # (tests/test_sampling.py); --naive_sample keeps the parity decoder.
+    from progen_tpu.sampling import sample, sample_fast
+
+    sample_tokens = sample if naive_sample else sample_fast
     from progen_tpu.tracking import make_tracker, render_sample_html
     from progen_tpu.training.optimizer import make_optimizer
     from progen_tpu.training.step import (
@@ -145,13 +160,27 @@ def main(
          else model_kwargs.get("dtype", "float32")}
     )
 
-    model = ProGen(config)
     optimizer = make_optimizer(learning_rate, weight_decay, max_grad_norm)
 
     # --- mesh: data_parallel -> absorb all devices on the data axis
     if mesh_data == 0:
         mesh_data = -1 if (data_parallel or mesh_seq * mesh_model > 1) else 1
     mesh = make_mesh(data=mesh_data, seq=mesh_seq, model=mesh_model)
+
+    if ring_attn and mesh.shape["seq"] < 2:
+        raise click.UsageError(
+            "--ring_attn needs a sequence-parallel mesh (--mesh_seq > 1)"
+        )
+    if ring_attn or config.use_ring_attn:
+        # config.use_ring_attn may also arrive via a resumed checkpoint's
+        # config; on a topology without a seq axis the model falls back to
+        # the local path by itself (mesh guard in LocalAttentionBlock)
+        import dataclasses
+
+        config = dataclasses.replace(config, use_ring_attn=True)
+        model = ProGen(config, mesh=mesh)
+    else:
+        model = ProGen(config)
 
     # --- state: cold init or sharded restore (never both)
     start_seq_index, run_id = 0, None

@@ -48,6 +48,12 @@ class ProGenConfig:
     param_dtype: str = "float32"
     # Use the Pallas local-attention kernel instead of the XLA reference path.
     use_pallas_attn: bool = False
+    # Use the EXPLICIT ring halo-exchange attention (parallel/ring_attention)
+    # instead of letting GSPMD infer the halo collectives. Takes effect only
+    # when the model is built with a mesh whose ``seq`` axis is > 1
+    # (``ProGen(config, mesh=mesh)``); otherwise falls back to the XLA path,
+    # so a checkpointed config restores cleanly on any topology.
+    use_ring_attn: bool = False
     # Rematerialize each block's activations during backprop.
     remat: bool = False
     # Incremental decoding mode: the model takes ONE token per call and

@@ -76,6 +76,9 @@ class LocalAttentionBlock(nn.Module):
     utils.py:116-117)."""
 
     config: ProGenConfig
+    # physical mesh, set by ProGen when built with one — enables the
+    # explicit ring-collective attention path (config.use_ring_attn)
+    mesh: object = None
 
     @nn.compact
     def __call__(self, x, sin, cos, pos=None):
@@ -116,11 +119,32 @@ class LocalAttentionBlock(nn.Module):
 
         if c.decode:
             out = self._decode_attend(q, k, v, pos)  # (b, h, 1, dh)
+        elif (
+            c.use_ring_attn
+            and self.mesh is not None
+            and dict(getattr(self.mesh, "shape", {})).get("seq", 1) > 1
+            and not self.is_initializing()
+        ):
+            # explicit one-hop halo exchange over the ``seq`` ring instead
+            # of GSPMD-inferred collectives. Skipped during init: the dummy
+            # init batch (1, L) doesn't divide over the data axis, and the
+            # op is parameter-free so init doesn't need it for shapes.
+            from progen_tpu.parallel.ring_attention import (
+                ring_local_attention,
+            )
+
+            out = ring_local_attention(
+                q, k, v, window_size=w, mesh=self.mesh
+            )
         elif c.use_pallas_attn:
             from progen_tpu.ops.pallas_attention import pallas_local_attention
 
-            # positional args: custom_vjp nondiff_argnums are positional
-            out = pallas_local_attention(q, k, v, w)
+            # positional args: custom_vjp nondiff_argnums are positional.
+            # Mosaic-compiled on TPU; interpreter elsewhere, so a config
+            # shipping use_pallas_attn=true (long8k.toml) stays runnable
+            # on CPU hosts (tests, smoke runs) without monkeypatching.
+            interpret = jax.default_backend() not in ("tpu", "axon")
+            out = pallas_local_attention(q, k, v, w, None, interpret)
         else:
             out = local_attention(q, k, v, window_size=w)
 

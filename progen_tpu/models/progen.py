@@ -43,11 +43,14 @@ class UniformBlock(nn.Module):
 
     config: ProGenConfig
     glu: bool
+    mesh: object = None
 
     @nn.compact
     def __call__(self, x, sin, cos):
         c = self.config
-        x = x + LocalAttentionBlock(c, name="attn")(x, sin, cos, None)
+        x = x + LocalAttentionBlock(c, mesh=self.mesh, name="attn")(
+            x, sin, cos, None
+        )
         x = x + FeedForwardBlock(c, glu=self.glu, name="ff")(x, None)
         x = nn.with_logical_constraint(x, ("batch", "seq_act", "embed_act"))
         return x, None
@@ -101,6 +104,10 @@ def stack_params(params: dict, config: ProGenConfig) -> dict:
 
 class ProGen(nn.Module):
     config: ProGenConfig
+    # physical mesh (jax.sharding.Mesh, hashable) — only consulted by the
+    # explicit-collective attention path (config.use_ring_attn); the GSPMD
+    # path needs no mesh on the model. Not serialized with the config.
+    mesh: object = None
 
     @nn.compact
     def __call__(self, tokens: jnp.ndarray) -> jnp.ndarray:
@@ -150,7 +157,9 @@ class ProGen(nn.Module):
                 length=n_uniform,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )
-            x, _ = scan_cls(c, glu=c.ff_glu, name="layers")(x, sin, cos)
+            x, _ = scan_cls(c, glu=c.ff_glu, mesh=self.mesh, name="layers")(
+                x, sin, cos
+            )
             start = n_uniform
         else:
             start = 0
@@ -158,7 +167,9 @@ class ProGen(nn.Module):
         for i in range(start, c.depth):
             use_gmlp = (c.depth - i) <= c.global_mlp_depth
             use_glu = (not use_gmlp) and c.ff_glu
-            x = x + attn_cls(c, name=f"attn{i}")(x, sin, cos, pos)
+            x = x + attn_cls(c, mesh=self.mesh, name=f"attn{i}")(
+                x, sin, cos, pos
+            )
             x = x + ff_cls(
                 c, glu=use_glu, spatial_gate=use_gmlp, name=f"ff{i}"
             )(x, pos)

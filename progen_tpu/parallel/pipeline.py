@@ -112,3 +112,92 @@ def pipeline_apply(
     # outs: (P, T, mb, ...); finished microbatches live on the last stage
     final = outs[n_stages - 1, n_stages - 1 : n_stages - 1 + M]
     return final.reshape((B,) + x.shape[1:])
+
+
+def pipeline_forward(
+    model,
+    params,
+    tokens: jnp.ndarray,
+    *,
+    mesh: Mesh,
+    axis: str = "model",
+    n_microbatches: int,
+) -> jnp.ndarray:
+    """Full ProGen forward with the uniform block stack executed as a
+    pipeline — the model-level integration of ``pipeline_apply``.
+
+    ``model`` is a ``ProGen`` built with ``config.scan_layers=True`` (the
+    stacked ``params['layers']`` subtree IS the pipeline's layer axis;
+    ``models/progen.stack_params`` converts unrolled checkpoints). Embedding,
+    RoPE tables, the trailing gMLP blocks, and the logits head run outside
+    the pipeline (they are O(1) in depth — the uniform stack is what
+    outgrows a chip); each is the SAME flax module the plain forward uses,
+    applied to the same param subtrees, so outputs match
+    ``model.apply({'params': params}, tokens)`` exactly.
+
+    Run OUTSIDE any ``nn.logical_axis_rules`` context: stages execute inside
+    ``shard_map``, where GSPMD sharding constraints don't apply (the
+    modules' ``with_logical_constraint`` calls no-op without active rules).
+    """
+    from flax import linen as nn
+
+    from progen_tpu.models.layers import (
+        FeedForwardBlock,
+        LocalAttentionBlock,
+        ScaleNorm,
+    )
+    from progen_tpu.models.progen import UniformBlock
+    from progen_tpu.ops.rotary import fixed_pos_embedding
+
+    c = model.config
+    if "layers" not in params:
+        raise ValueError(
+            "pipeline_forward needs the scan_layers stacked param layout "
+            "(use models.progen.stack_params to convert)"
+        )
+    n = tokens.shape[-1]
+    n_uniform = c.depth - c.global_mlp_depth
+
+    x = nn.Embed(
+        c.num_tokens,
+        c.dim,
+        dtype=c.compute_dtype,
+        param_dtype=c.params_dtype,
+        name="embed",
+    ).apply({"params": params["embed"]}, tokens)
+    sin, cos = fixed_pos_embedding(n, c.dim_head)
+
+    block = UniformBlock(c, glu=c.ff_glu)
+
+    def block_fn(layer_params, h):
+        h, _ = block.apply({"params": layer_params}, h, sin, cos)
+        return h
+
+    x = pipeline_apply(
+        block_fn,
+        params["layers"],
+        x,
+        mesh=mesh,
+        axis=axis,
+        n_microbatches=n_microbatches,
+    )
+
+    for i in range(n_uniform, c.depth):
+        use_gmlp = (c.depth - i) <= c.global_mlp_depth
+        x = x + LocalAttentionBlock(c).apply(
+            {"params": params[f"attn{i}"]}, x, sin, cos, None
+        )
+        x = x + FeedForwardBlock(
+            c, glu=(not use_gmlp) and c.ff_glu, spatial_gate=use_gmlp
+        ).apply({"params": params[f"ff{i}"]}, x, None)
+
+    x = ScaleNorm(c.layer_norm_epsilon, c.compute_dtype, c.params_dtype).apply(
+        {"params": params["ScaleNorm_0"]}, x
+    )
+    logits = nn.Dense(
+        c.num_tokens,
+        dtype=c.compute_dtype,
+        param_dtype=c.params_dtype,
+        name="to_logits",
+    ).apply({"params": params["to_logits"]}, x)
+    return logits.astype(jnp.float32)

@@ -130,3 +130,76 @@ class TestPipelineProGenBlocks:
         np.testing.assert_allclose(
             np.asarray(out), np.asarray(ref), atol=1e-5
         )
+
+
+class TestPipelineForwardRealModel:
+    """VERDICT round-2 item 6: the pipeline integrated with the ACTUAL
+    model — ProGen's uniform blocks (scan_layers stacked subtree) run as
+    pipeline stages, fwd + bwd parity vs the plain sequential forward."""
+
+    @pytest.fixture(scope="class")
+    def setup(self):
+        from flax import linen as nn
+
+        from progen_tpu.config import ProGenConfig
+        from progen_tpu.models.progen import ProGen
+
+        cfg = ProGenConfig(
+            num_tokens=32, dim=32, seq_len=32, depth=5, window_size=8,
+            global_mlp_depth=1, heads=2, dim_head=16, ff_mult=2,
+            dtype="float32", scan_layers=True,
+        )
+        model = ProGen(cfg)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(7), (8, cfg.seq_len), 1, cfg.num_tokens
+        )
+        params = nn.meta.unbox(
+            model.init(jax.random.PRNGKey(0), tokens)["params"]
+        )
+        ref_logits = model.apply({"params": params}, tokens)
+        return model, params, tokens, ref_logits
+
+    @pytest.mark.parametrize("stages,microbatches", [(4, 4), (2, 8)])
+    def test_forward_parity(self, setup, stages, microbatches):
+        from progen_tpu.parallel.pipeline import pipeline_forward
+
+        model, params, tokens, ref = setup
+        mesh = make_mesh(data=1, seq=1, model=stages)
+        out = pipeline_forward(
+            model, params, tokens, mesh=mesh, n_microbatches=microbatches
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5
+        )
+
+    def test_gradient_parity(self, setup):
+        from progen_tpu.parallel.pipeline import pipeline_forward
+
+        model, params, tokens, _ = setup
+        mesh = make_mesh(data=1, seq=1, model=4)
+        g_ref = jax.grad(
+            lambda p: model.apply({"params": p}, tokens).sum()
+        )(params)
+        g_pipe = jax.grad(
+            lambda p: pipeline_forward(
+                model, p, tokens, mesh=mesh, n_microbatches=4
+            ).sum()
+        )(params)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=5e-3
+            ),
+            g_ref,
+            g_pipe,
+        )
+
+    def test_unrolled_layout_rejected(self, setup):
+        from progen_tpu.parallel.pipeline import pipeline_forward
+
+        model, params, tokens, _ = setup
+        bad = {k: v for k, v in params.items() if k != "layers"}
+        mesh = make_mesh(data=1, seq=1, model=4)
+        with pytest.raises(ValueError, match="stacked param layout"):
+            pipeline_forward(
+                model, bad, tokens, mesh=mesh, n_microbatches=4
+            )
