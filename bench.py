@@ -1,63 +1,136 @@
-"""Throughput benchmark — training tokens/sec/chip + MFU on the real chip.
+"""Throughput benchmark suite — the round's real-TPU evidence, in one run.
 
-Runs the full donated train step (grad-accum scan + clip + masked AdamW) on
-the flagship ProGen-tiny config (README example, BASELINE.md config 1) with
-synthetic data, and prints ONE JSON line:
-  {"metric": "train_tokens_per_sec_per_chip", "value": ..., "unit":
-   "tokens/s/chip", "vs_baseline": ...}
+Driver contract: ``python bench.py`` prints a JSON line
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}``.
 
-vs_baseline: the reference publishes no numbers (BASELINE.md — README "(wip)",
-no benchmarks/ dir), so the denominator is this repo's own recorded round-1
-number when present (BENCH_r*.json), else 1.0 (i.e. the value itself is the
-baseline being established).
+On a live TPU the default run is a PHASED SUITE, each phase in its own
+subprocess (one chip claim at a time; a wedged phase is killed without
+taking the parent down):
 
-MFU accounting (extra keys, PaLM convention): flops/token =
-6*num_params + 12*depth*heads*dim_head*attn_ctx with attn_ctx = 2*window
-(each query attends to [prev | current] window). Peak: v5e 197 TFLOP/s bf16,
-v4 275, v5p 459; selected by device kind, default 197.
+  1. train-tiny       — headline: donated train step, ProGen-tiny (README
+                        example config, BASELINE.md config 1), bf16,
+                        reference recipe 4x4. tokens/sec/chip + MFU.
+                        The headline JSON line is printed (and flushed) the
+                        moment this phase finishes — insurance against a
+                        later phase wedging the relay.
+  2. kernel-w256/512  — Pallas local-attention kernel vs the XLA path,
+                        fwd+bwd, Mosaic-compiled (VERDICT round-2 item 2),
+                        including on-chip max-abs-error vs the golden.
+  3. train-tiny-pallas— the flagship with use_pallas_attn, vs phase 1.
+  4. train-long8k[-xla]— long-context config (8192/512, remat), Pallas per
+                        its TOML vs forced-XLA, side by side.
+  5. train-default / train-base — remaining BASELINE.md configs.
+  6. large-projection — ProGen-large (1.2B) HBM/flops sharding study
+                        (single chip can't hold 1.2B x 16B/param; the
+                        study reports the v5e-64 plan instead), no chip.
+
+Every phase result is appended to BENCH_DETAIL.json as it lands. At the
+end one FINAL line (same headline metric/value + per-phase summary) is
+printed — drivers that parse the last line get the rich record, drivers
+that parse the first still get the headline.
+
+vs_baseline: the reference publishes no numbers (BASELINE.md), so the
+denominator is this repo's own newest prior-round TPU record when present,
+else 1.0 (the value itself establishes the baseline).
+
+MFU: profiling.flops_per_token (PaLM convention, SGU spatial mix charged
+by actual per-token work) / per-device peak (v5e 197 TFLOP/s bf16).
+
+Off-TPU (dead relay / CPU host): a tiny functional smoke with a DISTINCT
+metric name, so a fallback number can never pollute the TPU baseline
+chain. A dead axon relay makes backend init HANG — hence the timed
+subprocess probe before anything touches jax.devices().
+
+Extra CLIs:
+  python bench.py kernel           — kernel phases only, one line.
+  python bench.py --config base    — one train phase in-process, one line.
 """
 
 from __future__ import annotations
 
 import glob
 import json
+import os
+import subprocess
+import sys
 import time
+from pathlib import Path
 
 import numpy as np
 
+_REPO = Path(__file__).resolve().parent
+_DETAIL_PATH = _REPO / "BENCH_DETAIL.json"
 
-def _tpu_probe_ok(timeout: float = 180.0) -> bool:
-    """Probe backend init in a SUBPROCESS: a dead axon relay makes
-    jax.devices() hang (not raise), which would swallow the whole bench.
-    Probed unconditionally — healthy backends (TPU or CPU-only hosts)
-    answer in seconds and the probe process releases any chip claim on
-    exit."""
-    import subprocess
-    import sys
+# (name, timeout_sec) in execution order; budget cuts from the tail
+_PHASES = (
+    ("train-tiny", 720),
+    ("kernel-w256", 420),
+    ("kernel-w512", 420),
+    ("train-tiny-pallas", 720),
+    ("train-long8k", 1080),
+    ("train-long8k-xla", 1080),
+    ("train-default", 600),
+    ("train-base", 720),
+)
 
+# per-config bench recipes: (grad_accum, micro_batch, iters)
+_RECIPES = {
+    "tiny": (4, 4, 10),      # reference train recipe, train.py:38-43
+    "default": (4, 4, 10),
+    "base": (2, 4, 6),
+    "long8k": (1, 2, 5),
+    "smoke": (2, 2, 3),      # CPU-fallback functional smoke
+}
+
+
+def _probe_platform(timeout: float = 180.0) -> str | None:
+    """Probe backend init in a SUBPROCESS and report its platform: a dead
+    axon relay makes jax.devices() hang (not raise), which would swallow
+    the whole bench. Returns "tpu"/"cpu"/... on success, None on a dead or
+    erroring backend. One probe serves both liveness and platform (the
+    probe process releases any chip claim on exit)."""
     try:
         r = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
             timeout=timeout,
             capture_output=True,
+            text=True,
         )
-        return r.returncode == 0
     except subprocess.TimeoutExpired:
-        return False
+        return None
+    return r.stdout.strip() if r.returncode == 0 else None
+
+
+def _tpu_probe_ok(timeout: float = 180.0) -> bool:
+    return _probe_platform(timeout) is not None
+
+
+# the axon relay's PJRT client is libtpu underneath and should report
+# "tpu"; accept the registration name too in case the plugin surfaces it
+_TPU_PLATFORMS = ("tpu", "axon")
+
+
+def _is_tpu_platform(platform: str | None) -> bool:
+    return platform in _TPU_PLATFORMS
+
+
+def _force_cpu():
+    import jax
+    import jax._src.xla_bridge as xb
+
+    jax.config.update("jax_platforms", "cpu")
+    xb._backend_factories.pop("axon", None)
 
 
 def _device_or_cpu_fallback():
     """jax.devices() with a CPU fallback when the TPU backend is
-    unreachable (dead relay: init HANGS, so the probe runs in a timed
-    subprocess; plain init errors are caught too) — the 'platform' key in
-    the emitted JSON distinguishes the outcomes."""
+    unreachable; the 'platform' key in the emitted JSON distinguishes the
+    outcomes."""
     import jax
 
     if not _tpu_probe_ok():
-        import jax._src.xla_bridge as xb
-
-        jax.config.update("jax_platforms", "cpu")
-        xb._backend_factories.pop("axon", None)
+        _force_cpu()
         return jax.devices()
     try:
         return jax.devices()
@@ -68,7 +141,7 @@ def _device_or_cpu_fallback():
 
 def _prior_round_value() -> float | None:
     best = None
-    for path in sorted(glob.glob("BENCH_r*.json")):
+    for path in sorted(glob.glob(str(_REPO / "BENCH_r*.json"))):
         try:
             rec = json.loads(open(path).read())
         except (OSError, json.JSONDecodeError):
@@ -83,48 +156,52 @@ def _prior_round_value() -> float | None:
     return best
 
 
-def main() -> None:
+# "smoke" pseudo-config: functional check at CPU-feasible shapes (the full
+# tiny config is minutes/step on a 1-core fallback host)
+_SMOKE_CONFIG = dict(
+    num_tokens=256, dim=64, depth=2, heads=2, dim_head=32, window_size=32,
+    seq_len=128, global_mlp_depth=1, ff_mult=2, dtype="float32",
+)
+
+
+def _load_config(name: str, **overrides):
+    from progen_tpu.config import ProGenConfig, load_toml_config
+
+    if name == "smoke":
+        kwargs = dict(_SMOKE_CONFIG)
+    else:
+        toml = _REPO / "configs" / "model" / f"{name}.toml"
+        kwargs = load_toml_config(str(toml))
+    kwargs.update(overrides)
+    kwargs.setdefault("dtype", "bfloat16")
+    return ProGenConfig.from_dict(kwargs)
+
+
+# --------------------------------------------------------------------------
+# phases (each runs in its own process via `bench.py _phase <name>`)
+# --------------------------------------------------------------------------
+
+
+def _train_bench(config_name: str, *, use_pallas=None) -> dict:
+    """One measured train-step benchmark for a named config. Returns the
+    result dict (also JSON-printed by the _phase entry point)."""
     import jax
 
-    _device_or_cpu_fallback()
-
-    from progen_tpu.config import ProGenConfig
+    from progen_tpu import profiling
     from progen_tpu.models.progen import ProGen
     from progen_tpu.parallel.partition import make_mesh, put_batch
     from progen_tpu.training.optimizer import make_optimizer
     from progen_tpu.training.step import compile_train_step, init_train_state
 
-    on_tpu = jax.devices()[0].platform == "tpu"
-    if on_tpu:
-        config = ProGenConfig(
-            num_tokens=256,
-            dim=512,
-            depth=12,
-            heads=8,
-            dim_head=64,
-            window_size=256,
-            seq_len=1024,
-            global_mlp_depth=2,
-            dtype="bfloat16",
-        )
-    else:
-        # CPU fallback (unreachable TPU): functional smoke at tiny shapes —
-        # the full config needs ~minutes/step on a 1-core host. The JSON
-        # stays honest via platform/config keys.
-        config = ProGenConfig(
-            num_tokens=256,
-            dim=64,
-            depth=2,
-            heads=2,
-            dim_head=32,
-            window_size=32,
-            seq_len=128,
-            global_mlp_depth=1,
-            ff_mult=2,
-            dtype="float32",
-        )
+    overrides = {}
+    if use_pallas is not None:
+        overrides["use_pallas_attn"] = use_pallas
+    config = _load_config(config_name, **overrides)
+    grad_accum, micro_bs, n_iters = _RECIPES[config_name]
+
     n_chips = len(jax.devices())
-    mesh = make_mesh()  # all devices on the data axis (1 on the bench chip)
+    micro_bs *= n_chips
+    mesh = make_mesh()
     model = ProGen(config)
     optimizer = make_optimizer()
     state, shardings = init_train_state(
@@ -132,20 +209,18 @@ def main() -> None:
     )
     step = compile_train_step(model, optimizer, state, shardings, mesh)
 
-    # reference recipe 4 x 4 on TPU; smoke shapes off-TPU
-    grad_accum, micro_bs = (4, 4 * n_chips) if on_tpu else (2, 2 * n_chips)
     rng = np.random.default_rng(0)
     batch = rng.integers(
-        1, 256, size=(grad_accum, micro_bs, config.seq_len + 1)
+        1, config.num_tokens, size=(grad_accum, micro_bs, config.seq_len + 1)
     ).astype(np.int32)
 
     with mesh:
         device_batch = put_batch(batch, mesh, accum_axis=True)
-        # warmup/compile
-        state, metrics = step(state, device_batch)
+        t0 = time.perf_counter()
+        state, metrics = step(state, device_batch)  # warmup/compile
         jax.block_until_ready(metrics["loss"])
+        compile_s = time.perf_counter() - t0
 
-        n_iters = 10 if on_tpu else 3
         t0 = time.perf_counter()
         for _ in range(n_iters):
             state, metrics = step(state, device_batch)
@@ -153,72 +228,56 @@ def main() -> None:
         dt = time.perf_counter() - t0
 
     tokens_per_step = grad_accum * micro_bs * config.seq_len
-    tokens_per_sec = tokens_per_step * n_iters / dt
-    per_chip = tokens_per_sec / n_chips
-
-    from progen_tpu import profiling
-
-    num_params = state.num_params()
+    per_chip = tokens_per_step * n_iters / dt / n_chips
     mfu = (
         per_chip
         * profiling.flops_per_token(config)
         / profiling.peak_flops(jax.devices()[0])
     )
-
-    prior = _prior_round_value()
-    result = {
-        # distinct metric off-TPU so a smoke number never poisons the
-        # cross-round TPU baseline chain
-        "metric": (
-            "train_tokens_per_sec_per_chip"
-            if on_tpu
-            else "cpu_fallback_smoke_tokens_per_sec"
-        ),
-        "value": round(per_chip, 1),
-        "unit": "tokens/s/chip",
-        "vs_baseline": (
-            round(per_chip / prior, 3) if (prior and on_tpu) else 1.0
-        ),
+    return {
+        "phase": f"train-{config_name}"
+        + ("-pallas" if use_pallas else "-xla" if use_pallas is False else ""),
+        "config": config_name,
+        "tokens_per_sec_per_chip": round(per_chip, 1),
         "mfu": round(mfu, 4),
-        "num_params": num_params,
-        "chips": n_chips,
         "step_ms": round(1000 * dt / n_iters, 1),
-        "config": (
-            "progen-tiny (dim=512 depth=12 seq=1024 w=256) bf16"
-            if on_tpu
-            else "cpu-fallback smoke (dim=64 depth=2 seq=128 w=32) f32"
-        ),
+        "compile_s": round(compile_s, 1),
+        "num_params": state.num_params(),
+        "batch": f"{grad_accum}x{micro_bs}x{config.seq_len}",
+        "dtype": config.dtype,
+        "use_pallas_attn": config.use_pallas_attn,
+        "loss": round(float(metrics["loss"]), 4),
+        "chips": n_chips,
         "platform": jax.devices()[0].platform,
     }
-    print(json.dumps(result))
 
 
-def kernel_bench() -> None:
-    """`python bench.py kernel` — Pallas windowed-attention kernel vs the
-    XLA path, fwd+bwd, tiny-config shapes. Not part of the driver contract
-    (which reads main()'s single line); records the kernel delta the
-    VERDICT asked for."""
+def _kernel_bench(window: int) -> dict:
+    """Pallas windowed-attention kernel vs the XLA path, fwd+bwd, at the
+    flagship shapes. On TPU the kernel is Mosaic-COMPILED (interpret only
+    off-TPU) and the on-chip error vs the XLA golden is recorded — the
+    non-interpret correctness evidence VERDICT round-2 asked for."""
     import jax
     import jax.numpy as jnp
-
-    _device_or_cpu_fallback()
 
     from progen_tpu.ops.attention import local_attention
     from progen_tpu.ops.pallas_attention import pallas_local_attention
 
-    on_tpu = jax.devices()[0].platform == "tpu"
+    on_tpu = _is_tpu_platform(jax.devices()[0].platform)
     if on_tpu:
-        b, h, n, d, w = 16, 8, 1024, 64, 256
+        b, h, n, d = 16, 8, 1024, 64
+        iters_f, iters_b = 20, 10
+        w = window
     else:
-        # interpret-mode Pallas is minutes/call at the TPU shapes — keep the
+        # interpret-mode Pallas is minutes/call at TPU shapes — keep the
         # off-TPU path a functional smoke, not a perf claim
-        b, h, n, d, w = 2, 2, 128, 32, 32
+        b, h, n, d = 2, 2, 128, 32
+        iters_f, iters_b = 2, 1
+        w = min(window, 32)
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
-    q, k, v = (
-        jax.random.normal(kk, (b, h, n, d), jnp.bfloat16) for kk in ks
-    )
+    q, k, v = (jax.random.normal(kk, (b, h, n, d), jnp.bfloat16) for kk in ks)
 
-    def time_fn(fn, iters=20):
+    def time_fn(fn, iters):
         out = jax.block_until_ready(fn(q, k, v))  # compile
         t0 = time.perf_counter()
         for _ in range(iters):
@@ -227,7 +286,6 @@ def kernel_bench() -> None:
         return (time.perf_counter() - t0) / iters, out
 
     xla_fwd = jax.jit(lambda q, k, v: local_attention(q, k, v, window_size=w))
-    # interpret mode on CPU (compiled Mosaic is TPU-only)
     pl_fwd = jax.jit(
         lambda q, k, v: pallas_local_attention(q, k, v, w, None, not on_tpu)
     )
@@ -241,30 +299,290 @@ def kernel_bench() -> None:
                  .astype(jnp.float32).sum(), argnums=(0, 1, 2))
     )
 
-    t_xf, o_x = time_fn(xla_fwd)
-    t_pf, o_p = time_fn(pl_fwd)
-    err = float(
+    t_xf, o_x = time_fn(xla_fwd, iters_f)
+    t_pf, o_p = time_fn(pl_fwd, iters_f)
+    fwd_err = float(
         jnp.abs(o_x.astype(jnp.float32) - o_p.astype(jnp.float32)).max()
     )
-    t_xb, _ = time_fn(xla_bwd, iters=10)
-    t_pb, _ = time_fn(pl_bwd, iters=10)
-    print(json.dumps({
-        "metric": "pallas_vs_xla_local_attention",
-        "fwd_ms": {"xla": round(t_xf * 1e3, 2), "pallas": round(t_pf * 1e3, 2)},
-        "bwd_ms": {"xla": round(t_xb * 1e3, 2), "pallas": round(t_pb * 1e3, 2)},
+    t_xb, g_x = time_fn(xla_bwd, iters_b)
+    t_pb, g_p = time_fn(pl_bwd, iters_b)
+    bwd_err = max(
+        float(jnp.abs(a.astype(jnp.float32) - b_.astype(jnp.float32)).max())
+        for a, b_ in zip(g_x, g_p)
+    )
+    return {
+        "phase": f"kernel-w{window}",
+        "fwd_ms": {"xla": round(t_xf * 1e3, 3), "pallas": round(t_pf * 1e3, 3)},
+        "bwd_ms": {"xla": round(t_xb * 1e3, 3), "pallas": round(t_pb * 1e3, 3)},
         "fwd_speedup": round(t_xf / t_pf, 2),
         "bwd_speedup": round(t_xb / t_pb, 2),
-        "max_abs_err": err,
+        "fwd_max_abs_err": fwd_err,
+        "bwd_max_abs_err": bwd_err,
         "shape": f"b{b} h{h} n{n} d{d} w{w} bf16",
+        "mosaic_compiled": on_tpu,
         "platform": jax.devices()[0].platform,
-        "pallas_interpret_mode": not on_tpu,
+    }
+
+
+def _large_projection() -> dict:
+    """ProGen-large (1.2B) sharding study — no chip run: the optimizer
+    state alone (f32 params + AdamW m/v = 12 B/param) plus transient f32
+    grads exceeds one v5e chip's 16 GB HBM, so the BASELINE.md target for
+    this config is the v5e-64 plan, reported from closed-form math."""
+    from progen_tpu import profiling
+    from progen_tpu.config import ProGenConfig, load_toml_config
+
+    cfg = ProGenConfig.from_dict(
+        load_toml_config(str(_REPO / "configs" / "model" / "large.toml"))
+    )
+    p = cfg.num_params()
+    state_bytes = 12 * p      # f32 params + Adam m + v
+    grads_bytes = 4 * p       # transient f32 grads (donated step)
+    fpt = profiling.flops_per_token(cfg)
+    peak = 197e12             # v5e bf16
+    # v5e-64 mesh plan: model=8 (qkv/mlp/vocab sharded), data=8
+    model_ax = 8
+    per_chip_state = (state_bytes + grads_bytes) / model_ax
+    target_mfu = 0.45
+    projected_tps_chip = target_mfu * peak / fpt
+    return {
+        "phase": "large-projection",
+        "config": "large",
+        "num_params": p,
+        "state_plus_grads_gb": round((state_bytes + grads_bytes) / 2**30, 2),
+        "hbm_fit_single_chip": False,
+        "mesh_plan": {"data": 8, "model": model_ax, "seq": 1},
+        "per_chip_state_gb_at_model8": round(per_chip_state / 2**30, 2),
+        "flops_per_token": fpt,
+        "projected_tokens_per_sec_per_chip_at_45pct_mfu": round(
+            projected_tps_chip, 1
+        ),
+        "note": "single v5e chip cannot hold 1.2B x 16B/param; "
+                "remat+scan_layers in large.toml; TP rules shard "
+                "qkv/mlp/vocab over `model`, GSPMD inserts one all-reduce "
+                "per block (partition.py rule table)",
+    }
+
+
+def _cpu_smoke() -> dict:
+    """Off-TPU functional smoke (dead relay / CPU host) — the shared
+    _train_bench flow at smoke shapes, re-keyed under a DISTINCT metric
+    name so it never poisons the TPU baseline chain."""
+    res = _train_bench("smoke")
+    return {
+        "metric": "cpu_fallback_smoke_tokens_per_sec",
+        "value": res["tokens_per_sec_per_chip"],
+        "unit": "tokens/s/chip",
+        "vs_baseline": 1.0,
+        "mfu": res["mfu"],
+        "num_params": res["num_params"],
+        "chips": res["chips"],
+        "step_ms": res["step_ms"],
+        "config": "cpu-fallback smoke (dim=64 depth=2 seq=128 w=32) f32",
+        "platform": res["platform"],
+    }
+
+
+def run_phase(name: str) -> dict:
+    if name.startswith("kernel-w"):
+        return _kernel_bench(int(name[len("kernel-w"):]))
+    if name == "train-tiny-pallas":
+        return _train_bench("tiny", use_pallas=True)
+    if name == "train-long8k-xla":
+        return _train_bench("long8k", use_pallas=False)
+    if name.startswith("train-"):
+        return _train_bench(name[len("train-"):])
+    if name == "large-projection":
+        return _large_projection()
+    raise ValueError(f"unknown phase {name}")
+
+
+# --------------------------------------------------------------------------
+# orchestrator
+# --------------------------------------------------------------------------
+
+
+def _write_detail(detail: dict) -> None:
+    try:
+        _DETAIL_PATH.write_text(json.dumps(detail, indent=1))
+    except OSError as e:  # never let bookkeeping kill the bench
+        print(f"[bench] detail write failed: {e}", file=sys.stderr)
+
+
+def _run_phase_subprocess(name: str, timeout: float):
+    """One phase in its own process (own chip claim, own crash domain).
+    SIGTERM then SIGKILL on timeout — kinder to the relay than an instant
+    kill mid-claim."""
+    proc = subprocess.Popen(
+        [sys.executable, str(_REPO / "bench.py"), "_phase", name],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        cwd=str(_REPO),
+        text=True,
+        env={**os.environ, "BENCH_REQUIRE_TPU": "1"},
+    )
+    try:
+        out, err = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.terminate()
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+        return {"phase": name, "error": f"timeout after {timeout:.0f}s"}
+    if proc.returncode != 0:
+        return {
+            "phase": name,
+            "error": f"exit {proc.returncode}",
+            "stderr_tail": err[-800:],
+        }
+    for line in reversed(out.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    return {"phase": name, "error": "no JSON in phase output"}
+
+
+def main() -> None:
+    budget = float(os.environ.get("BENCH_BUDGET_SEC", "3000"))
+    started = time.perf_counter()
+    # one probe serves liveness + platform (phase children skip re-probing
+    # via BENCH_REQUIRE_TPU — a dead relay there surfaces as a timeout)
+    on_tpu = _is_tpu_platform(_probe_platform())
+
+    detail: dict = {
+        "schema": "bench-suite-v1",
+        "platform": "tpu" if on_tpu else "cpu-fallback",
+        "phases": [],
+    }
+
+    if not on_tpu:
+        _force_cpu()
+        result = _cpu_smoke()
+        detail["phases"].append(result)
+        detail["phases"].append(_large_projection())
+        _write_detail(detail)
+        print(json.dumps(result), flush=True)
+        return
+
+    headline = None
+    prior = _prior_round_value()
+    for name, timeout in _PHASES:
+        remaining = budget - (time.perf_counter() - started)
+        if remaining < 90:
+            detail["phases"].append(
+                {"phase": name, "error": "skipped: budget exhausted"}
+            )
+            continue
+        res = _run_phase_subprocess(name, min(timeout, remaining))
+        if "error" not in res and not _is_tpu_platform(
+            res.get("platform", "tpu")
+        ):
+            # belt-and-suspenders vs BENCH_REQUIRE_TPU: a fallback result
+            # must never be recorded as TPU suite evidence
+            res = {
+                "phase": name,
+                "error": f"phase ran on {res.get('platform')}, not tpu",
+            }
+        detail["phases"].append(res)
+        _write_detail(detail)
+        print(f"[bench] {name}: {json.dumps(res)[:300]}", file=sys.stderr)
+
+        if name == "train-tiny" and "error" not in res:
+            per_chip = res["tokens_per_sec_per_chip"]
+            headline = {
+                "metric": "train_tokens_per_sec_per_chip",
+                "value": per_chip,
+                "unit": "tokens/s/chip",
+                "vs_baseline": round(per_chip / prior, 3) if prior else 1.0,
+                "mfu": res["mfu"],
+                "num_params": res["num_params"],
+                "chips": res["chips"],
+                "step_ms": res["step_ms"],
+                "config": "progen-tiny (dim=512 depth=12 seq=1024 w=256) "
+                          "bf16",
+                "platform": "tpu",
+            }
+            # print + flush NOW: if a later phase wedges the relay and the
+            # driver kills us, the headline is already on stdout
+            print(json.dumps(headline), flush=True)
+        if "error" in res and not _tpu_probe_ok(120):
+            detail["relay_died_after"] = name
+            _write_detail(detail)
+            break
+
+    detail["phases"].append(_large_projection())
+    _write_detail(detail)
+
+    if headline is None:
+        # tiny phase failed: fall back to an honest CPU smoke so the driver
+        # still gets a record (platform key distinguishes it)
+        _force_cpu()
+        result = _cpu_smoke()
+        detail["phases"].append(result)
+        _write_detail(detail)
+        print(json.dumps(result), flush=True)
+        return
+
+    summary = {}
+    for res in detail["phases"]:
+        ph = res.get("phase", "?")
+        if "error" in res:
+            summary[ph] = res["error"][:60]
+        elif ph.startswith("kernel"):
+            summary[ph] = {
+                "fwd_speedup": res["fwd_speedup"],
+                "bwd_speedup": res["bwd_speedup"],
+            }
+        elif ph.startswith("train") and ph != "train-tiny":
+            summary[ph] = {
+                "tps_chip": res["tokens_per_sec_per_chip"],
+                "mfu": res["mfu"],
+            }
+    print(json.dumps({**headline, "suite": summary}), flush=True)
+
+
+def kernel_main() -> None:
+    _device_or_cpu_fallback()
+    results = [_kernel_bench(256), _kernel_bench(512)]
+    print(json.dumps({
+        "metric": "pallas_vs_xla_local_attention",
+        "results": results,
+        "platform": results[0]["platform"],
     }))
 
 
-if __name__ == "__main__":
-    import sys
+def _load_repo_env() -> None:
+    """The shipped .env (LIBTPU_INIT_ARGS etc.) must apply to benches the
+    same as to the CLIs — otherwise the recorded numbers measure a
+    different libtpu/XLA configuration than production training."""
+    from progen_tpu.utils.env import load_env_file
 
-    if len(sys.argv) > 1 and sys.argv[1] == "kernel":
-        kernel_bench()
+    load_env_file(str(_REPO / ".env"))
+
+
+if __name__ == "__main__":
+    _load_repo_env()
+    if len(sys.argv) > 2 and sys.argv[1] == "_phase":
+        if os.environ.get("BENCH_REQUIRE_TPU") == "1":
+            # orchestrated child: the parent already probed; a dead relay
+            # HANGS here and surfaces as the parent's phase timeout, and a
+            # CPU fallback must NOT masquerade as a TPU phase result
+            import jax
+
+            if not _is_tpu_platform(jax.devices()[0].platform):
+                print("BENCH_REQUIRE_TPU: backend is not TPU",
+                      file=sys.stderr)
+                sys.exit(3)
+        else:
+            _device_or_cpu_fallback()
+        print(json.dumps(run_phase(sys.argv[2])))
+    elif len(sys.argv) > 1 and sys.argv[1] == "kernel":
+        kernel_main()
+    elif len(sys.argv) > 2 and sys.argv[1] == "--config":
+        _device_or_cpu_fallback()
+        print(json.dumps(_train_bench(sys.argv[2])))
     else:
         main()

@@ -1,0 +1,58 @@
+"""bench.py suite plumbing (pure-python parts — phases themselves run on
+hardware via the driver; see bench.py docstring)."""
+
+import importlib.util
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location("bench", REPO / "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["bench"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestPhasePlumbing:
+    def test_every_phase_resolvable(self, bench):
+        # every scheduled phase must map to a runner + a recipe
+        for name, timeout in bench._PHASES:
+            assert timeout > 0
+            if name.startswith("train-"):
+                cfg = name[len("train-"):]
+                cfg = cfg.removesuffix("-pallas").removesuffix("-xla")
+                assert cfg in bench._RECIPES, name
+                assert (REPO / "configs" / "model" / f"{cfg}.toml").exists()
+            elif name.startswith("kernel-w"):
+                assert int(name[len("kernel-w"):]) in (256, 512)
+
+    def test_unknown_phase_raises(self, bench):
+        with pytest.raises(ValueError):
+            bench.run_phase("nope")
+
+    def test_prior_round_ignores_cpu_fallback(self, bench):
+        # BENCH_r01/r02 are empty/cpu-fallback records: the TPU baseline
+        # chain must stay unpolluted (None until a platform=tpu record)
+        assert bench._prior_round_value() is None
+
+    def test_large_projection_math(self, bench):
+        res = bench._large_projection()
+        assert res["num_params"] > 1.2e9  # the 1.2B BASELINE.md config
+        assert not res["hbm_fit_single_chip"]  # 16 B/param > 16 GB HBM
+        # per-chip share at model=8 must fit v5e HBM with room for
+        # activations
+        assert res["per_chip_state_gb_at_model8"] < 8
+
+    def test_config_loader_defaults_bf16(self, bench):
+        cfg = bench._load_config("tiny")
+        assert cfg.dtype == "bfloat16"
+        cfg = bench._load_config("long8k")
+        assert cfg.use_pallas_attn  # enabled in the shipped TOML
+        cfg = bench._load_config("long8k", use_pallas_attn=False)
+        assert not cfg.use_pallas_attn

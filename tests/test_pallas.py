@@ -84,30 +84,22 @@ class TestPallasBackward:
 class TestModelIntegration:
     def test_use_pallas_attn_flag(self):
         """config.use_pallas_attn must trace end-to-end (VERDICT weak #2:
-        the flag used to ImportError)."""
+        the flag used to ImportError). The model dispatch auto-selects
+        interpret mode off-TPU, so no monkeypatching is needed."""
         from progen_tpu.config import ProGenConfig
         from progen_tpu.models.progen import ProGen
-        from progen_tpu.ops import pallas_attention
 
-        # route the flag through interpret mode for the CPU test
-        orig = pallas_attention.pallas_local_attention
-        # custom_vjp takes positional args only
-        patched = lambda q, k, v, w: orig(q, k, v, w, None, True)
-        pallas_attention.pallas_local_attention = patched
-        try:
-            cfg = ProGenConfig(
-                num_tokens=32, dim=32, seq_len=32, depth=2, window_size=8,
-                global_mlp_depth=1, heads=2, dim_head=16, ff_mult=2,
-                dtype="float32", use_pallas_attn=True,
-            )
-            model = ProGen(cfg)
-            tokens = jnp.zeros((1, 32), jnp.int32)
-            params = model.init(jax.random.PRNGKey(0), tokens)["params"]
-            out = model.apply({"params": params}, tokens)
-            assert out.shape == (1, 32, 32)
+        cfg = ProGenConfig(
+            num_tokens=32, dim=32, seq_len=32, depth=2, window_size=8,
+            global_mlp_depth=1, heads=2, dim_head=16, ff_mult=2,
+            dtype="float32", use_pallas_attn=True,
+        )
+        model = ProGen(cfg)
+        tokens = jnp.zeros((1, 32), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+        out = model.apply({"params": params}, tokens)
+        assert out.shape == (1, 32, 32)
 
-            cfg_ref = ProGenConfig(**{**cfg.to_dict(), "use_pallas_attn": False})
-            ref = ProGen(cfg_ref).apply({"params": params}, tokens)
-            np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
-        finally:
-            pallas_attention.pallas_local_attention = orig
+        cfg_ref = ProGenConfig(**{**cfg.to_dict(), "use_pallas_attn": False})
+        ref = ProGen(cfg_ref).apply({"params": params}, tokens)
+        np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)

@@ -1,0 +1,51 @@
+"""Cross-platform TPU lowering of the Pallas kernel — no TPU needed.
+
+``jax.export`` with ``platforms=["tpu"]`` runs the full Pallas→Mosaic MLIR
+lowering (where BlockSpec/rank/layout errors surface) at trace time on any
+host; only the final Mosaic→LLO step happens on a real chip. This is the
+regression net for VERDICT weak #2: the kernel's TPU lowering is validated
+on every CPU suite run instead of only on first real-chip contact.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from progen_tpu.ops.pallas_attention import pallas_local_attention
+
+
+def _export_for_tpu(fn, *args):
+    return jax.export.export(jax.jit(fn), platforms=["tpu"])(*args)
+
+
+class TestTpuLowering:
+    @pytest.mark.parametrize("window", [256, 512])
+    def test_forward_lowers_for_tpu(self, window):
+        q = jnp.zeros((2, 8, 1024, 64), jnp.bfloat16)
+        exp = _export_for_tpu(
+            functools.partial(pallas_local_attention, window_size=window),
+            q, q, q,
+        )
+        mlir = exp.mlir_module()
+        assert "tpu_custom_call" in mlir  # the Mosaic kernel made it in
+
+    def test_backward_lowers_for_tpu(self):
+        q = jnp.zeros((2, 8, 1024, 64), jnp.bfloat16)
+
+        def loss(q, k, v):
+            return pallas_local_attention(q, k, v, 256).astype(
+                jnp.float32
+            ).sum()
+
+        exp = _export_for_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, q, q)
+        assert "tpu_custom_call" in exp.mlir_module()
+
+    def test_forward_lowers_f32(self):
+        q = jnp.zeros((1, 2, 512, 64), jnp.float32)
+        exp = _export_for_tpu(
+            functools.partial(pallas_local_attention, window_size=128),
+            q, q, q,
+        )
+        assert "tpu_custom_call" in exp.mlir_module()
