@@ -39,6 +39,10 @@ class ProGenConfig:
     rotate_value: bool = True
     sgu_init_eps: float = 1e-3
     layer_norm_epsilon: float = 1e-5  # hk.LayerNorm default
+    # Recursive block-triangular SGU mix (ops/sgu.py): same math as the
+    # dense tril-masked matmul but ~half the MACs at long context. 0 keeps
+    # the reference-shaped dense path; long8k sets 1024.
+    sgu_block_size: int = 0
 
     # --- TPU-native knobs (additive; no reference equivalent) ---
     # Mixed precision: params live in float32, compute in `dtype`, logits are

@@ -286,7 +286,9 @@ class SpatialGatingUnit(nn.Module):
             )
             gate = mixed[:, None, :].astype(x.dtype)
         else:
-            gate = causal_sgu_mix(gate, weights, biases).astype(x.dtype)
+            gate = causal_sgu_mix(
+                gate, weights, biases, c.sgu_block_size
+            ).astype(x.dtype)
         x = x * gate
         return nn.Dense(
             self.dim_out,

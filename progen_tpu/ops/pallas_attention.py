@@ -12,15 +12,25 @@ including the window-0 zero-key softmax dilution). Design:
     ``i > 0``), reproducing the reference's zero-padding;
   * scores/softmax accumulate in f32 whatever the input dtype (bf16-safe);
   * backward is flash-style: recompute the (w, 2w) probabilities from the
-    saved q/k/v instead of storing them; each program emits dq for its
-    window and d(k2)/d(v2) for its [prev|cur] halo pair, and the halo
-    overlap is resolved OUTSIDE the kernel by one shifted add (window i's
-    dk gets the "current" half of program i plus the "previous" half of
-    program i+1). The discarded first-half at program 0 is exactly the
-    gradient of the phantom zero keys.
+    saved q/k/v instead of storing them. TWO implementations, selectable
+    via ``bwd_impl`` (both golden-tested; the kernel bench times both):
 
-VMEM at w=512, d=64, f32: q/k2/v2 ~0.4 MB + probs (w, 2w) 2 MB — fits
-comfortably; at w=256 everything halves.
+    - ``"kv"`` (default) — kv-centric: program j recomputes the softmax
+      rows of windows j AND j+1 (the only two consumers of k_j/v_j) and
+      emits dq_j, dk_j, dv_j directly, fully combined in-register. Extra
+      score recompute, but NO f32 halo scratch in HBM and no combine
+      pass — windowed attention is bandwidth-bound, so trading one (w,2w)
+      matmul for 2x duplicated f32 k/v-grad HBM traffic is the
+      TPU-friendly direction.
+    - ``"halo"`` — q-centric: each program emits dq for its window and
+      d(k2)/d(v2) for its [prev|cur] halo pair as (bh, nw, 2w, d) f32
+      scratch, and the halo overlap is resolved OUTSIDE the kernel by one
+      shifted add (window i's dk gets the "current" half of program i
+      plus the "previous" half of program i+1). The discarded first-half
+      at program 0 is exactly the gradient of the phantom zero keys.
+
+VMEM at w=512, d=64, f32: q/k2/v2 ~0.4 MB + probs (w, 2w) 2 MB (the kv
+backward holds two rows' worth); at w=256 everything halves.
 """
 
 from __future__ import annotations
@@ -53,17 +63,7 @@ def _fwd_kernel(q_ref, kp_ref, kc_ref, vp_ref, vc_ref, o_ref, *, scale):
     w = q_ref.shape[1]
     q = q_ref[0].astype(jnp.float32)
     k2, v2 = _halo_kv(kp_ref, kc_ref, vp_ref, vc_ref, jnp.float32)
-
-    s = jax.lax.dot_general(
-        q, k2,
-        dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * scale
-    s = jnp.where(_window_mask(w), s, ATTN_MASK_VALUE)
-    s = s - s.max(axis=-1, keepdims=True)
-    e = jnp.exp(s)
-    p = e / e.sum(axis=-1, keepdims=True)
-
+    p = _softmax_row(q, k2, w, scale)
     o = jnp.dot(p, v2, preferred_element_type=jnp.float32)
     o_ref[0] = o.astype(o_ref.dtype)
 
@@ -76,23 +76,8 @@ def _bwd_kernel(
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
     k2, v2 = _halo_kv(kp_ref, kc_ref, vp_ref, vc_ref, jnp.float32)
-
-    s = jax.lax.dot_general(
-        q, k2,
-        dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * scale
-    s = jnp.where(_window_mask(w), s, ATTN_MASK_VALUE)
-    s = s - s.max(axis=-1, keepdims=True)
-    e = jnp.exp(s)
-    p = e / e.sum(axis=-1, keepdims=True)  # (w, 2w)
-
-    dp = jax.lax.dot_general(  # dO @ v2^T -> (w, 2w)
-        do, v2,
-        dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))  # softmax bwd
+    p = _softmax_row(q, k2, w, scale)  # (w, 2w)
+    ds = _ds_from(p, do, v2)  # softmax bwd
     # masked positions have p == 0 => ds == 0 there; no extra mask needed
 
     dq_ref[0] = (
@@ -112,20 +97,93 @@ def _bwd_kernel(
     ).astype(dv2_ref.dtype)
 
 
+def _softmax_row(q, k2, w, scale):
+    """Masked softmax probabilities for one window's (w, 2w) attention
+    row (shared by the forward and both backward recomputes)."""
+    s = jax.lax.dot_general(
+        q, k2,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    s = jnp.where(_window_mask(w), s, ATTN_MASK_VALUE)
+    s = s - s.max(axis=-1, keepdims=True)
+    e = jnp.exp(s)
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+def _ds_from(p, do, v2):
+    dp = jax.lax.dot_general(  # dO @ v2^T -> (w, 2w)
+        do, v2,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
+
+
+def _bwd_kv_kernel(
+    qc_ref, qn_ref, doc_ref, don_ref,
+    kp_ref, kc_ref, kn_ref, vp_ref, vc_ref, vn_ref,
+    dq_ref, dk_ref, dv_ref, *, scale,
+):
+    """kv-centric backward: program j owns k_j/v_j, whose only consumers
+    are query windows j ([prev|CUR] half) and j+1 ([PREV|cur] half).
+    Recompute both softmax rows and emit dq_j, dk_j, dv_j fully combined —
+    no halo scratch, no post-kernel combine."""
+    w = qc_ref.shape[1]
+    f32 = jnp.float32
+    j = pl.program_id(1)
+    not_first = (j > 0).astype(f32)
+    has_next = (j < pl.num_programs(1) - 1).astype(f32)
+
+    qc, doc = qc_ref[0].astype(f32), doc_ref[0].astype(f32)
+    kc, vc = kc_ref[0].astype(f32), vc_ref[0].astype(f32)
+
+    # ---- row j: k2 = [k_{j-1} | k_j] (zeroed at j == 0) ----
+    k2 = jnp.concatenate([kp_ref[0].astype(f32) * not_first, kc], axis=0)
+    v2 = jnp.concatenate([vp_ref[0].astype(f32) * not_first, vc], axis=0)
+    p = _softmax_row(qc, k2, w, scale)
+    ds = _ds_from(p, doc, v2)
+
+    dq_ref[0] = (
+        jnp.dot(ds, k2, preferred_element_type=f32) * scale
+    ).astype(dq_ref.dtype)
+    # current-half contributions to dk_j / dv_j
+    tq = lambda a, b: jax.lax.dot_general(  # a^T @ b -> (w, d)
+        a, b,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=f32,
+    )
+    dk = tq(ds[:, w:], qc) * scale
+    dv = tq(p[:, w:], doc)
+
+    # ---- row j+1: k2 = [k_j | k_{j+1}] (garbage at the clamped last
+    # program, zeroed via has_next) ----
+    qn, don = qn_ref[0].astype(f32), don_ref[0].astype(f32)
+    k2n = jnp.concatenate([kc, kn_ref[0].astype(f32)], axis=0)
+    v2n = jnp.concatenate([vc, vn_ref[0].astype(f32)], axis=0)
+    pn = _softmax_row(qn, k2n, w, scale)
+    dsn = _ds_from(pn, don, v2n)
+    dk = dk + has_next * tq(dsn[:, :w], qn) * scale
+    dv = dv + has_next * tq(pn[:, :w], don)
+
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _index_maps(w: int, d: int):
+    cur = lambda b, i: (b, i, 0)
+    prev = lambda b, i: (b, jnp.maximum(i - 1, 0), 0)
+    block = (1, w, d)
+    spec = lambda idx: pl.BlockSpec(block, idx, memory_space=pltpu.VMEM)
+    return cur, prev, spec
+
+
 def _specs(w: int, d: int):
     """(q, k_prev, k_cur, v_prev, v_cur) block specs on a (bh, n, d) array.
     The halo spec points one window back (clamped at 0; program 0 zeroes it
     in-register)."""
-    cur = lambda b, i: (b, i, 0)
-    prev = lambda b, i: (b, jnp.maximum(i - 1, 0), 0)
-    block = (1, w, d)
-    return [
-        pl.BlockSpec(block, cur, memory_space=pltpu.VMEM),
-        pl.BlockSpec(block, prev, memory_space=pltpu.VMEM),
-        pl.BlockSpec(block, cur, memory_space=pltpu.VMEM),
-        pl.BlockSpec(block, prev, memory_space=pltpu.VMEM),
-        pl.BlockSpec(block, cur, memory_space=pltpu.VMEM),
-    ]
+    cur, prev, spec = _index_maps(w, d)
+    return [spec(cur), spec(prev), spec(cur), spec(prev), spec(cur)]
 
 
 def _flops(bh: int, n: int, d: int, w: int, n_matmuls: int) -> pl.CostEstimate:
@@ -136,7 +194,7 @@ def _flops(bh: int, n: int, d: int, w: int, n_matmuls: int) -> pl.CostEstimate:
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def pallas_local_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -144,10 +202,16 @@ def pallas_local_attention(
     window_size: int,
     scale: float | None = None,
     interpret: bool = False,
+    bwd_impl: str = "kv",
 ) -> jnp.ndarray:
     """q, k, v: (batch, heads, n, dim_head), n % window_size == 0.
     Returns (batch, heads, n, dim_head) in q.dtype. ``interpret=True`` runs
-    the kernel in the Pallas interpreter (CPU tests)."""
+    the kernel in the Pallas interpreter (CPU tests). ``bwd_impl``:
+    ``"kv"`` (combined-in-register, default) or ``"halo"`` (f32 halo
+    scratch + shifted add) — see the module docstring."""
+    if bwd_impl not in ("kv", "halo"):
+        # validate at the call site, not first-grad-time deep in the VJP
+        raise ValueError(f"unknown bwd_impl {bwd_impl!r}")
     out, _ = _fwd(q, k, v, window_size, scale, interpret)
     return out
 
@@ -176,11 +240,11 @@ def _fwd(q, k, v, window_size, scale, interpret):
     return out.reshape(b, h, n, d), (q, k, v)
 
 
-def _fwd_rule(q, k, v, window_size, scale, interpret):
+def _fwd_rule(q, k, v, window_size, scale, interpret, bwd_impl):
     return _fwd(q, k, v, window_size, scale, interpret)
 
 
-def _bwd_rule(window_size, scale, interpret, residuals, g):
+def _bwd_rule(window_size, scale, interpret, bwd_impl, residuals, g):
     q, k, v = residuals
     b, h, n, d = q.shape
     w = window_size
@@ -189,6 +253,31 @@ def _bwd_rule(window_size, scale, interpret, residuals, g):
     bh, nw = b * h, n // w
     qf, kf, vf = (t.reshape(bh, n, d) for t in (q, k, v))
     gf = g.reshape(bh, n, d)
+
+    if bwd_impl == "kv":
+        cur, prev, spec = _index_maps(w, d)
+        nxt = lambda b_, i: (b_, jnp.minimum(i + 1, nw - 1), 0)
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_bwd_kv_kernel, scale=scale),
+            grid=(bh, nw),
+            in_specs=[
+                spec(cur), spec(nxt),              # q_j, q_{j+1}
+                spec(cur), spec(nxt),              # do_j, do_{j+1}
+                spec(prev), spec(cur), spec(nxt),  # k_{j-1}, k_j, k_{j+1}
+                spec(prev), spec(cur), spec(nxt),  # v_{j-1}, v_j, v_{j+1}
+            ],
+            out_specs=[spec(cur)] * 3,
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, n, d), q.dtype),
+                jax.ShapeDtypeStruct((bh, n, d), k.dtype),
+                jax.ShapeDtypeStruct((bh, n, d), v.dtype),
+            ],
+            cost_estimate=_flops(bh, n, d, w, 8),
+            interpret=interpret,
+        )(qf, qf, gf, gf, kf, kf, kf, vf, vf, vf)
+        return tuple(t.reshape(b, h, n, d) for t in (dq, dk, dv))
+    if bwd_impl != "halo":
+        raise ValueError(f"unknown bwd_impl {bwd_impl!r}")
 
     halo_block = pl.BlockSpec(
         (1, 1, 2 * w, d), lambda b_, i: (b_, i, 0, 0), memory_space=pltpu.VMEM

@@ -8,6 +8,15 @@ parameterized layer lives in progen_tpu/models/layers.py.
 
 The (n, n) weight is O(seq_len^2) parameters — the reference's long-context
 bottleneck (SURVEY.md section 5). The mix accumulates in float32 on the MXU.
+
+Causality wastes half the MXU work in the dense formulation: ``tril(W) @ g``
+multiplies by n²/2 structural zeros that XLA cannot skip (the mask is data,
+not structure). ``block_size`` enables a recursive block-triangular
+decomposition — the strictly-lower-left quadrant is a FULL (unmasked)
+matmul, and only the two diagonal quadrants recurse — cutting MACs toward
+~n²/2 with plain XLA matmuls: differentiable by autodiff, shardable by
+GSPMD, no custom kernel needed. At n=8192 with block_size=1024 the mix does
+0.56x the dense MACs (1.8x fewer flops).
 """
 
 from __future__ import annotations
@@ -15,18 +24,63 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
-def causal_sgu_mix(gate: jnp.ndarray, weights: jnp.ndarray, biases: jnp.ndarray):
+def _dense_mix(gate: jnp.ndarray, weights: jnp.ndarray) -> jnp.ndarray:
+    """tril-masked dense mix (the reference formulation), f32 accumulate."""
+    n = weights.shape[0]
+    mask = jnp.tril(jnp.ones((n, n), dtype=bool))
+    w = jnp.where(mask, weights, 0).astype(jnp.float32)
+    return jnp.einsum(
+        "...nd,mn->...md", gate, w, preferred_element_type=jnp.float32
+    )
+
+
+def _block_triangular_mix(
+    gate: jnp.ndarray, weights: jnp.ndarray, block_size: int
+) -> jnp.ndarray:
+    """out[m] = sum_{j<=m} W[m, j] gate[j], recursively:
+
+        [ out_top ]   [ tri(W_tt) @ g_top                      ]
+        [ out_bot ] = [ W_bt @ g_top  +  tri(W_bb) @ g_bot     ]
+
+    where W_bt (the lower-left quadrant) is entirely below the diagonal —
+    a full matmul with no mask — and only tri(...) recurses. Recursion is
+    unrolled at trace time (static shapes)."""
+    n = weights.shape[0]
+    if n <= block_size or n % 2:
+        return _dense_mix(gate, weights)
+    h = n // 2
+    g_top, g_bot = gate[..., :h, :], gate[..., h:, :]
+    out_top = _block_triangular_mix(g_top, weights[:h, :h], block_size)
+    lower_left = jnp.einsum(
+        "...jd,mj->...md",
+        g_top,
+        weights[h:, :h].astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    out_bot = lower_left + _block_triangular_mix(
+        g_bot, weights[h:, h:], block_size
+    )
+    return jnp.concatenate([out_top, out_bot], axis=-2)
+
+
+def causal_sgu_mix(
+    gate: jnp.ndarray,
+    weights: jnp.ndarray,
+    biases: jnp.ndarray,
+    block_size: int = 0,
+):
     """gate: (..., n, d); weights: (n, n) [row m attends to columns <= m];
     biases: (n, 1). Returns (..., n, d): out[m] = sum_{j<=m} W[m, j] gate[j] + b[m].
 
     Matches einsum('n d, m n -> m d', gate, tril(W)) + b of the reference.
+    ``block_size > 0`` switches to the recursive block-triangular
+    formulation (same math, ~half the MACs at long context); 0 keeps the
+    reference-shaped dense masked matmul.
     """
-    n = gate.shape[-2]
-    mask = jnp.tril(jnp.ones((n, n), dtype=bool))
-    w = jnp.where(mask, weights, 0).astype(jnp.float32)
-    mixed = jnp.einsum(
-        "...nd,mn->...md", gate.astype(jnp.float32), w,
-        preferred_element_type=jnp.float32,
-    )
+    gate32 = gate.astype(jnp.float32)
+    if block_size > 0:
+        mixed = _block_triangular_mix(gate32, weights, block_size)
+    else:
+        mixed = _dense_mix(gate32, weights)
     mixed = mixed + biases.astype(jnp.float32)
     return mixed.astype(gate.dtype)

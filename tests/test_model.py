@@ -170,6 +170,9 @@ def test_long8k_config_shape_soundness():
     catches any shape/window/SGU wiring error at that scale."""
     cfg, out_state, _, _ = _trace_config("long8k")
     assert cfg.seq_len == 8192 and cfg.window_size == 512
+    # the shipped long-context recipe: Pallas attention + block-triangular
+    # SGU + remat, all traced through the grad path by this harness
+    assert cfg.use_pallas_attn and cfg.sgu_block_size == 1024 and cfg.remat
     # SGU spatial matrices really are (8192, 8192) on the last two layers
     sgu = out_state.params["ff11"]["sgu"]["spatial_weights"]
     assert sgu.shape == (8192, 8192)

@@ -172,3 +172,71 @@ class TestSGU:
         expected = jnp.einsum("nd,mn->md", gate, wm) + b
         out = causal_sgu_mix(gate[None], w, b)[0]
         np.testing.assert_allclose(out, expected, atol=1e-5)
+
+    @pytest.mark.parametrize("block", [4, 8, 16, 64])
+    def test_block_triangular_matches_dense(self, block):
+        """The recursive block-triangular mix is the SAME math as the
+        dense tril-masked matmul, reassociated — parity at every block
+        size, including block >= n (pure dense fallback)."""
+        key = jax.random.PRNGKey(3)
+        n, d = 32, 8
+        gate = jax.random.normal(key, (2, n, d))
+        w = jax.random.normal(jax.random.PRNGKey(4), (n, n))
+        b = jax.random.normal(jax.random.PRNGKey(5), (n, 1))
+        dense = causal_sgu_mix(gate, w, b)
+        blocked = causal_sgu_mix(gate, w, b, block)
+        np.testing.assert_allclose(blocked, dense, atol=1e-5)
+
+    def test_block_triangular_odd_n_falls_back(self):
+        # odd sizes can't split in half: must silently use the dense path
+        n, d = 10, 4
+        gate = jax.random.normal(jax.random.PRNGKey(6), (1, n, d))
+        w = jax.random.normal(jax.random.PRNGKey(7), (n, n))
+        b = jnp.zeros((n, 1))
+        np.testing.assert_allclose(
+            causal_sgu_mix(gate, w, b, 4), causal_sgu_mix(gate, w, b),
+            atol=1e-5,
+        )
+
+    def test_block_triangular_grads_match(self):
+        n, d = 32, 4
+        gate = jax.random.normal(jax.random.PRNGKey(8), (1, n, d))
+        w = jax.random.normal(jax.random.PRNGKey(9), (n, n))
+        b = jax.random.normal(jax.random.PRNGKey(10), (n, 1))
+
+        def loss(w, gate, b, block):
+            out = causal_sgu_mix(gate, w, b, block)
+            return (out * jnp.arange(out.size).reshape(out.shape)).sum()
+
+        for arg in range(3):
+            gd = jax.grad(loss, argnums=arg)(w, gate, b, 0)
+            gb = jax.grad(loss, argnums=arg)(w, gate, b, 8)
+            np.testing.assert_allclose(gb, gd, atol=2e-4, rtol=1e-5)
+
+    def test_blocked_mix_saves_macs(self):
+        """Count the actual dot MACs in the jaxpr: the blocked form must do
+        meaningfully fewer multiply-accumulates than the dense mask."""
+
+        def macs(block):
+            n, d = 64, 8
+            gate = jnp.zeros((1, n, d))
+            w = jnp.zeros((n, n))
+            b = jnp.zeros((n, 1))
+            jaxpr = jax.make_jaxpr(
+                lambda g, w, b: causal_sgu_mix(g, w, b, block)
+            )(gate, w, b)
+            total = 0
+            for eqn in jaxpr.jaxpr.eqns:
+                if eqn.primitive.name == "dot_general":
+                    lhs, rhs = (v.aval for v in eqn.invars)
+                    dims, _ = eqn.params["dimension_numbers"]
+                    contract = int(
+                        np.prod([lhs.shape[a] for a in dims[0]])
+                    )
+                    total += (
+                        int(np.prod(lhs.shape)) // contract
+                        * int(np.prod(rhs.shape))
+                    )
+            return total
+
+        assert macs(16) < 0.65 * macs(0)

@@ -52,11 +52,13 @@ class TestPallasForward:
 
 class TestPallasBackward:
     @pytest.mark.parametrize("window", [8, 16])
-    def test_grads_match_xla_golden(self, window):
+    @pytest.mark.parametrize("bwd_impl", ["kv", "halo"])
+    def test_grads_match_xla_golden(self, window, bwd_impl):
         q, k, v = _qkv(3)
 
         def loss_pallas(q, k, v):
-            out = pallas_local_attention(q, k, v, window, None, True)
+            out = pallas_local_attention(q, k, v, window, None, True,
+                                         bwd_impl)
             return (out * jnp.arange(out.size).reshape(out.shape)).sum()
 
         def loss_ref(q, k, v):
@@ -70,15 +72,45 @@ class TestPallasBackward:
                 a, b, atol=2e-3, rtol=2e-3, err_msg=f"d{name} mismatch"
             )
 
-    def test_last_window_keys_get_gradient(self):
-        """The shifted halo add must not drop the final window."""
+    def test_bwd_impls_agree(self):
+        """The kv-centric and halo backwards are the same math reassociated
+        differently — grads must agree to f32 reassociation tolerance."""
+        q, k, v = _qkv(5, (2, 2, 64, 16))
+
+        def grads(impl):
+            return jax.grad(
+                lambda q, k, v: pallas_local_attention(
+                    q, k, v, 16, None, True, impl
+                ).sum(),
+                argnums=(0, 1, 2),
+            )(q, k, v)
+
+        for a, b, name in zip(grads("kv"), grads("halo"), "qkv"):
+            np.testing.assert_allclose(
+                a, b, atol=1e-5, rtol=1e-5, err_msg=f"d{name} mismatch"
+            )
+
+    @pytest.mark.parametrize("bwd_impl", ["kv", "halo"])
+    def test_last_window_keys_get_gradient(self, bwd_impl):
+        """Neither backward may drop the final window's k/v gradient."""
         q, k, v = _qkv(4, (1, 1, 32, 8))
 
         def f(k):
-            return pallas_local_attention(q, k, v, 8, None, True).sum()
+            return pallas_local_attention(
+                q, k, v, 8, None, True, bwd_impl
+            ).sum()
 
         gk = jax.grad(f)(k)
         assert float(jnp.abs(gk[:, :, -8:]).sum()) > 0
+
+    def test_unknown_bwd_impl_raises(self):
+        q, k, v = _qkv(6, (1, 1, 16, 8))
+        with pytest.raises(ValueError, match="bwd_impl"):
+            jax.grad(
+                lambda q: pallas_local_attention(
+                    q, k, v, 8, None, True, "nope"
+                ).sum()
+            )(q)
 
 
 class TestModelIntegration:

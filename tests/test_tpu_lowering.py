@@ -31,13 +31,27 @@ class TestTpuLowering:
         mlir = exp.mlir_module()
         assert "tpu_custom_call" in mlir  # the Mosaic kernel made it in
 
-    def test_backward_lowers_for_tpu(self):
+    @pytest.mark.parametrize("bwd_impl", ["kv", "halo"])
+    def test_backward_lowers_for_tpu(self, bwd_impl):
         q = jnp.zeros((2, 8, 1024, 64), jnp.bfloat16)
 
         def loss(q, k, v):
-            return pallas_local_attention(q, k, v, 256).astype(
-                jnp.float32
-            ).sum()
+            return pallas_local_attention(
+                q, k, v, 256, None, False, bwd_impl
+            ).astype(jnp.float32).sum()
+
+        exp = _export_for_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, q, q)
+        assert "tpu_custom_call" in exp.mlir_module()
+
+    @pytest.mark.parametrize("bwd_impl", ["kv", "halo"])
+    def test_backward_lowers_for_tpu_w512(self, bwd_impl):
+        # the long8k shapes: w=512 is where VMEM pressure peaks
+        q = jnp.zeros((1, 8, 2048, 64), jnp.bfloat16)
+
+        def loss(q, k, v):
+            return pallas_local_attention(
+                q, k, v, 512, None, False, bwd_impl
+            ).astype(jnp.float32).sum()
 
         exp = _export_for_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, q, q)
         assert "tpu_custom_call" in exp.mlir_module()
