@@ -201,3 +201,31 @@ def pipeline_forward(
         name="to_logits",
     ).apply({"params": params["to_logits"]}, x)
     return logits.astype(jnp.float32)
+
+
+def make_pipeline_train_step(
+    model,
+    optimizer,
+    *,
+    mesh: Mesh,
+    axis: str = "model",
+    n_microbatches: int,
+):
+    """The production train step (EOS-masked CE, grad-accum scan, clip,
+    masked AdamW — training/step.make_train_step) with the forward replaced
+    by ``pipeline_forward``: the depth-sharded deployment path when the
+    layer stack outgrows one chip even after TP.
+
+    Uses ``rules=()``: sharding is explicit (shard_map over ``axis``), so
+    GSPMD logical constraints must stay inert — they cannot apply inside
+    manual axes. Gradients flow through the pipeline as its autodiff
+    transpose (cotangents ride the reversed ppermute ring)."""
+    from progen_tpu.training.step import make_train_step
+
+    def forward(params, ids):
+        return pipeline_forward(
+            model, params, ids,
+            mesh=mesh, axis=axis, n_microbatches=n_microbatches,
+        )
+
+    return make_train_step(model, optimizer, rules=(), forward_fn=forward)

@@ -38,28 +38,38 @@ from progen_tpu.training.state import TrainState
 Metrics = dict
 
 
-def batch_loss(model, params, data: jnp.ndarray) -> jnp.ndarray:
+def batch_loss(model, params, data: jnp.ndarray, forward_fn=None) -> jnp.ndarray:
     """data: (mb, seq_len+1) int tokens. Mean over per-sequence masked CE
-    (matches vmap-then-mean of utils.py:67,77)."""
+    (matches vmap-then-mean of utils.py:67,77). ``forward_fn(params, ids)
+    -> logits`` overrides the plain ``model.apply`` (e.g. the pipelined
+    forward, parallel/pipeline.make_pipeline_train_step)."""
     ids, labels = data[..., :-1], data[..., 1:]
-    logits = model.apply({"params": params}, ids)
+    if forward_fn is None:
+        logits = model.apply({"params": params}, ids)
+    else:
+        logits = forward_fn(params, ids)
     return cross_entropy(logits, labels).mean()
 
 
 def make_train_step(
-    model, optimizer, rules=DEFAULT_RULES
+    model, optimizer, rules=DEFAULT_RULES, *, forward_fn=None
 ) -> Callable[[TrainState, jnp.ndarray], Tuple[TrainState, Metrics]]:
     """Returns train_step(state, batch) -> (state, metrics).
 
     batch: (grad_accum, micro_batch, seq_len+1) ints. Gradients are averaged
     over the accumulation axis *before* clipping (see optimizer.py for why
     this deliberately differs from the reference's apply_every placement).
+
+    ``forward_fn`` swaps the model forward while keeping the loss /
+    accumulation / clip / AdamW machinery identical (pipeline path passes
+    ``rules=()`` — explicit shard_map sharding instead of GSPMD
+    annotations, which cannot apply inside manual axes).
     """
 
     def train_step(state: TrainState, batch: jnp.ndarray):
         with nn.logical_axis_rules(rules):
             grad_fn = jax.value_and_grad(
-                lambda p, mb: batch_loss(model, p, mb)
+                lambda p, mb: batch_loss(model, p, mb, forward_fn)
             )
 
             def micro(grads_acc, mb):
