@@ -70,7 +70,10 @@ def sharded_abstract_state(abstract_state: Any, shardings: Any) -> Any:
 
 
 def get_checkpoint_fns(
-    path: str, keep_last_n: int = DEFAULT_KEEP_LAST_N
+    path: str,
+    keep_last_n: int = DEFAULT_KEEP_LAST_N,
+    *,
+    async_save: bool = False,
 ) -> Tuple[Callable, Callable, Callable]:
     """(reset, get_last, save) over local or gs:// ``path``.
 
@@ -81,6 +84,16 @@ def get_checkpoint_fns(
         restores straight to its mesh shard.
     reset() -> None: wipe the checkpoint directory (guarded by --new +
         interactive confirm at the CLI layer, train.py:85-88).
+
+    ``async_save``: the array write overlaps subsequent training steps —
+    Orbax copies device arrays to host synchronously (so the donated
+    TrainState buffers are safe to reuse immediately) and commits to
+    storage in the background. The ``meta.json`` finalizer runs at the
+    NEXT ``save`` (or at ``save.flush()``, which the train loop calls on
+    exit): until then the checkpoint has no meta.json and restore skips it
+    as incomplete — the same invariant the sync path relies on for
+    crash-atomicity, so a death mid-write can never be mistaken for a
+    complete checkpoint.
     """
     # TensorStore requires absolute paths; the reference-parity default
     # ('./ckpts', train.py:47) arrives relative
@@ -115,6 +128,41 @@ def get_checkpoint_fns(
         else:  # CloudPath-like
             p.rmtree()
 
+    # async machinery: one AsyncCheckpointer reused across saves; the
+    # (target, meta) awaiting its meta.json finalizer
+    _async: dict = {}
+
+    def _retain() -> None:
+        """Drop complete checkpoints beyond keep_last_n (reference
+        semantics, checkpoint.py:33-37) — shared by sync and async."""
+        stale = _complete(_list())[:-keep_last_n] if keep_last_n else []
+        for p in stale:
+            _rmtree(p)
+
+    def _finalize_pending() -> None:
+        """Wait for the in-flight async array write, then publish its
+        meta.json + run retention (coordinator only)."""
+        import jax
+
+        if "ckptr" in _async:
+            _async["ckptr"].wait_until_finished()
+        item = _async.pop("pending", None)
+        if item is not None and jax.process_index() == 0:
+            target, meta = item
+            _write_text(target / "meta.json", json.dumps(meta))
+            _retain()
+
+    def _close() -> None:
+        """Publish any pending save, then shut the background commit
+        thread down deterministically (otherwise a non-daemon Orbax thread
+        outlives the last flush and delays interpreter exit on aborts).
+        Safe to call repeatedly; the next save() recreates the
+        checkpointer."""
+        _finalize_pending()
+        ckptr = _async.pop("ckptr", None)
+        if ckptr is not None:
+            ckptr.close()
+
     def save(package: Package) -> str:
         # unix-time naming (checkpoint.py:27-30) made collision-proof: two
         # saves within the same second get strictly increasing names, so
@@ -123,6 +171,8 @@ def get_checkpoint_fns(
         # process 0's stamp is broadcast; meta.json and retention are
         # coordinator-only side effects.
         import jax
+
+        _finalize_pending()  # no-op unless an async save is in flight
 
         stamp = int(time.time())
         existing = _list()
@@ -140,22 +190,35 @@ def get_checkpoint_fns(
         target = root / name
         if not _is_gcs(path) and jax.process_index() == 0:
             root.mkdir(parents=True, exist_ok=True)
+        meta = {
+            "next_seq_index": int(package.next_seq_index),
+            "model_config": package.model_config,
+            "run_id": package.run_id,
+        }
+        if async_save:
+            if "ckptr" not in _async:
+                _async["ckptr"] = ocp.AsyncCheckpointer(
+                    ocp.StandardCheckpointHandler()
+                )
+            # device->host copy happens before this returns (donation-safe);
+            # storage commit runs in the background; meta.json publishes at
+            # the next save()/flush()
+            _async["ckptr"].save(
+                target / "state", args=ocp.args.StandardSave(package.state)
+            )
+            _async["pending"] = (target, meta)
+            return str(target)
         with ocp.StandardCheckpointer() as ckptr:
             ckptr.save(target / "state", package.state)  # collective
         if jax.process_index() == 0:
             # metadata written after the state commit; a checkpoint without
             # meta.json is treated as incomplete and skipped on restore
-            meta = {
-                "next_seq_index": int(package.next_seq_index),
-                "model_config": package.model_config,
-                "run_id": package.run_id,
-            }
             _write_text(target / "meta.json", json.dumps(meta))
-            # retention (reference keeps keep_last_n, checkpoint.py:33-37)
-            stale = _complete(_list())[:-keep_last_n] if keep_last_n else []
-            for p in stale:
-                _rmtree(p)
+            _retain()
         return str(target)
+
+    save.flush = _finalize_pending  # await + publish the in-flight save
+    save.close = _close  # flush + stop the background commit thread
 
     def _complete(candidates):
         return [p for p in candidates if _exists(p / "meta.json")]

@@ -69,6 +69,11 @@ def confirm(question: str) -> bool:
               help="explicit ring halo-exchange attention over the seq mesh "
                    "axis (requires --mesh_seq > 1) instead of GSPMD-inferred "
                    "collectives")
+@click.option("--async_checkpoint", default=False, is_flag=True,
+              help="overlap checkpoint writes with training (device arrays "
+                   "are snapshotted to host synchronously; the storage "
+                   "commit runs in the background and finalizes at the next "
+                   "save)")
 def main(
     seed,
     batch_size,
@@ -99,6 +104,7 @@ def main(
     hardware_rng,
     naive_sample,
     ring_attn,
+    async_checkpoint,
 ):
     from progen_tpu.checkpoint import Package, get_checkpoint_fns
     from progen_tpu.config import ProGenConfig, load_toml_config
@@ -134,7 +140,8 @@ def main(
     initialize_distributed()
 
     reset_ckpt, get_last, save_ckpt = get_checkpoint_fns(
-        checkpoint_path, keep_last_n=checkpoint_keep_n
+        checkpoint_path, keep_last_n=checkpoint_keep_n,
+        async_save=async_checkpoint,
     )
     if new:
         if not confirm(
@@ -393,6 +400,11 @@ def main(
             from jax import profiler as jax_profiler
 
             jax_profiler.stop_trace()
+        # async mode: publish any committed-but-unfinalized checkpoint and
+        # stop the background thread even on aborts (e.g. the non-finite-
+        # loss raise) — every periodic save's state was verified finite
+        # before it was saved, so the pending snapshot is always good
+        save_ckpt.close()
 
     # final checkpoint so short runs (e.g. --num_steps) always persist;
     # next_seq_index counts exactly the records consumed by executed steps
@@ -404,6 +416,7 @@ def main(
             run_id=run_id,
         )
     )
+    save_ckpt.close()  # async mode: publish the final save before exit
     tracker.finish()
 
 

@@ -112,4 +112,25 @@ with mesh_tp:
     )
     print(f"LOSS_TP {float(metrics_tp['loss']):.6f}", flush=True)
 
+# --- phase 3: explicit RING attention ACROSS hosts — mesh (1, 2, 4) puts
+# the two seq shards on different processes, so the one-hop k/v halo
+# ppermute crosses the process boundary (Gloo here; ICI on a real torus).
+# Same fresh init + same global batch as phase 2 -> identical loss.
+import dataclasses
+
+cfg_ring = dataclasses.replace(CFG, use_ring_attn=True)
+mesh_ring = make_mesh(data=1, seq=2, model=4)
+model_ring = ProGen(cfg_ring, mesh=mesh_ring)
+state_r, shardings_r = init_train_state(
+    model_ring, optimizer, jax.random.PRNGKey(0), CFG.seq_len, mesh=mesh_ring
+)
+step_r = compile_train_step(
+    model_ring, optimizer, state_r, shardings_r, mesh_ring
+)
+with mesh_ring:
+    state_r, metrics_r = step_r(
+        state_r, put_batch(both[None], mesh_ring, accum_axis=True)
+    )
+    print(f"LOSS_RING {float(metrics_r['loss']):.6f}", flush=True)
+
 print("WORKER_OK", flush=True)

@@ -61,7 +61,7 @@ def test_two_process_training_matches_single(tmp_path):
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=540)
+            out, _ = p.communicate(timeout=720)
             outs.append(out.decode())
     finally:
         for p in procs:  # never leak workers (they hold the port + CPU)
@@ -95,6 +95,20 @@ def test_two_process_training_matches_single(tmp_path):
     (tp0,), (tp1,) = tp_loss(outs[0]), tp_loss(outs[1])
     np.testing.assert_allclose(tp0, tp1, rtol=1e-6)
     np.testing.assert_allclose(tp0, l0[0], rtol=1e-5)
+
+    # cross-host RING-attention phase: the seq axis spans the two
+    # processes, so every block's k/v halo ppermute crosses hosts; same
+    # init + batch as the TP phase -> identical loss
+    def ring_loss(text):
+        return [
+            float(line.split()[1])
+            for line in text.splitlines()
+            if line.startswith("LOSS_RING")
+        ]
+
+    (r0,), (r1,) = ring_loss(outs[0]), ring_loss(outs[1])
+    np.testing.assert_allclose(r0, r1, rtol=1e-6)
+    np.testing.assert_allclose(r0, l0[0], rtol=1e-5)
 
     # single-process baseline on the SAME global batches (the loss is a
     # mean over the batch — row order from record dealing is irrelevant)
