@@ -665,7 +665,16 @@ if __name__ == "__main__":
     elif len(sys.argv) > 1 and sys.argv[1] == "kernel":
         kernel_main()
     elif len(sys.argv) > 2 and sys.argv[1] == "--config":
-        _device_or_cpu_fallback()
+        devs = _device_or_cpu_fallback()
+        if not _is_tpu_platform(devs[0].platform) and sys.argv[2] != "smoke":
+            # the real configs are minutes/step on a 1-core CPU fallback;
+            # refuse rather than look hung (smoke stays runnable anywhere)
+            print(
+                f"--config {sys.argv[2]} needs a TPU backend "
+                "(use --config smoke off-TPU)",
+                file=sys.stderr,
+            )
+            sys.exit(2)
         print(json.dumps(_train_bench(sys.argv[2])))
     else:
         main()

@@ -186,6 +186,15 @@ def _specs(w: int, d: int):
     return [spec(cur), spec(prev), spec(cur), spec(prev), spec(cur)]
 
 
+# every kernel here writes disjoint output blocks per grid step (the halo
+# backward's overlap is resolved OUTSIDE the kernel), so Mosaic may reorder
+# and pipeline both grid dimensions freely. (CompilerParams was named
+# TPUCompilerParams before jax 0.7 — accept either.)
+_PARALLEL_GRID = getattr(
+    pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+)(dimension_semantics=("parallel", "parallel"))
+
+
 def _flops(bh: int, n: int, d: int, w: int, n_matmuls: int) -> pl.CostEstimate:
     return pl.CostEstimate(
         flops=n_matmuls * 2 * bh * n * 2 * w * d,
@@ -235,6 +244,7 @@ def _fwd(q, k, v, window_size, scale, interpret):
         ),
         out_shape=jax.ShapeDtypeStruct((bh, n, d), q.dtype),
         cost_estimate=_flops(bh, n, d, w, 2),
+        compiler_params=_PARALLEL_GRID,
         interpret=interpret,
     )(qf, kf, kf, vf, vf)
     return out.reshape(b, h, n, d), (q, k, v)
@@ -273,6 +283,7 @@ def _bwd_rule(window_size, scale, interpret, bwd_impl, residuals, g):
                 jax.ShapeDtypeStruct((bh, n, d), v.dtype),
             ],
             cost_estimate=_flops(bh, n, d, w, 8),
+            compiler_params=_PARALLEL_GRID,
             interpret=interpret,
         )(qf, qf, gf, gf, kf, kf, kf, vf, vf, vf)
         return tuple(t.reshape(b, h, n, d) for t in (dq, dk, dv))
@@ -304,6 +315,7 @@ def _bwd_rule(window_size, scale, interpret, bwd_impl, residuals, g):
             jax.ShapeDtypeStruct((bh, nw, 2 * w, d), jnp.float32),
         ],
         cost_estimate=_flops(bh, n, d, w, 5),
+        compiler_params=_PARALLEL_GRID,
         interpret=interpret,
     )(qf, kf, kf, vf, vf, gf)
 

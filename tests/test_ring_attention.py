@@ -87,7 +87,7 @@ class TestModelIntegration:
     ring-collective attention as a path the real model (and therefore the
     train step) can invoke — full-model fwd/bwd parity vs the plain path."""
 
-    def _setup(self, seq_shards, scan_layers=False):
+    def _setup(self, seq_shards, scan_layers=False, remat=False):
         import dataclasses
 
         from flax import linen as nn
@@ -98,7 +98,7 @@ class TestModelIntegration:
         cfg = ProGenConfig(
             num_tokens=32, dim=32, seq_len=64, depth=3, window_size=8,
             global_mlp_depth=1, heads=2, dim_head=16, ff_mult=2,
-            dtype="float32", scan_layers=scan_layers,
+            dtype="float32", scan_layers=scan_layers, remat=remat,
         )
         mesh = make_mesh(data=2, seq=seq_shards, model=1)
         plain = ProGen(cfg)
@@ -134,14 +134,17 @@ class TestModelIntegration:
         )
         assert jax.tree.structure(params) == jax.tree.structure(ring_params)
 
-    def test_gradient_parity(self):
-        plain, ring, params, tokens = self._setup(2)
+    # remat=True: long8k ships remat; jax.checkpoint over the shard_map
+    # ring must give the same grads as the plain path
+    @pytest.mark.parametrize("remat", [False, True])
+    def test_gradient_parity(self, remat):
+        plain, ring, params, tokens = self._setup(2, remat=remat)
 
         def loss(model, p):
             return model.apply({"params": p}, tokens).astype(jnp.float32).sum()
 
-        g_ref = jax.grad(lambda p: loss(plain, p))(params)
-        g_ring = jax.grad(lambda p: loss(ring, p))(params)
+        g_ref = jax.jit(jax.grad(lambda p: loss(plain, p)))(params)
+        g_ring = jax.jit(jax.grad(lambda p: loss(ring, p)))(params)
         jax.tree.map(
             lambda a, b: np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), atol=3e-3, rtol=2e-5
