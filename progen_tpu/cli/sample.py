@@ -38,15 +38,20 @@ import jax
 @click.option(
     "--num_samples",
     default=1,
-    help="decode this many sequences from the prime in one batched pass "
-    "(batched mode always uses the full-forward decode; --naive is moot)",
+    help="decode this many sequences from the prime in one batched "
+    "KV-cache pass (--naive switches to the full-forward batched decode)",
 )
 def main(seed, checkpoint_path, prime, top_k, naive, num_samples):
     from progen_tpu.checkpoint import get_checkpoint_fns
     from progen_tpu.config import ProGenConfig
     from progen_tpu.data.tokenizer import decode_tokens, encode_tokens
     from progen_tpu.models.progen import ProGen
-    from progen_tpu.sampling import sample, sample_batched, sample_fast
+    from progen_tpu.sampling import (
+        sample,
+        sample_batched,
+        sample_fast,
+        sample_fast_batched,
+    )
 
     _, get_last, _ = get_checkpoint_fns(checkpoint_path)
     # params-only restore: sampling never needs the optimizer moments
@@ -68,7 +73,8 @@ def main(seed, checkpoint_path, prime, top_k, naive, num_samples):
 
     if num_samples > 1:
         primes = np.tile(prime_tokens, (num_samples, 1))
-        sampled = sample_batched(
+        batched_fn = sample_batched if naive else sample_fast_batched
+        sampled = batched_fn(
             jax.random.PRNGKey(seed), model, params, primes,
             config.seq_len, top_k=top_k, add_bos=True,
         )

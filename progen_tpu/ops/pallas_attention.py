@@ -188,11 +188,10 @@ def _specs(w: int, d: int):
 
 # every kernel here writes disjoint output blocks per grid step (the halo
 # backward's overlap is resolved OUTSIDE the kernel), so Mosaic may reorder
-# and pipeline both grid dimensions freely. (CompilerParams was named
-# TPUCompilerParams before jax 0.7 — accept either.)
-_PARALLEL_GRID = getattr(
-    pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
-)(dimension_semantics=("parallel", "parallel"))
+# and pipeline both grid dimensions freely
+_PARALLEL_GRID = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel")
+)
 
 
 def _flops(bh: int, n: int, d: int, w: int, n_matmuls: int) -> pl.CostEstimate:

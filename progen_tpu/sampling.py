@@ -198,7 +198,7 @@ def _decode_incremental(model, params, cache, key, seq, start_pos, length, top_k
 
 
 @functools.lru_cache(maxsize=8)
-def _cache_init_fn(model, sharding):
+def _cache_init_fn(model, sharding, batch: int = 1):
     """Compiled zeroed-cache builder, cached on (model, sharding) so a
     train loop's cadenced samples re-EXECUTE it (fresh cache arrays) without
     re-TRACING it every cadence. ``sharding`` is the params' mesh sharding,
@@ -214,7 +214,7 @@ def _cache_init_fn(model, sharding):
         out_shardings = NamedSharding(sharding.mesh, PartitionSpec())
     return jax.jit(
         lambda: model.init(
-            jax.random.PRNGKey(0), jnp.zeros((1, 1), jnp.int32)
+            jax.random.PRNGKey(0), jnp.zeros((batch, 1), jnp.int32)
         )["cache"],
         out_shardings=out_shardings,
     )
@@ -233,6 +233,20 @@ def sample_fast(
     config.decode mode (rolling two-window ring buffer + token-shift states
     + SGU gate history) instead of the naive path's full forward per token.
     Same sampling semantics as `sample`."""
+    # validate before the (comparatively) expensive cache-init compile
+    seq, start = _prepare_seq(model, prime, length, add_bos)
+    dec_model, params, cache = _decode_setup(model, params, batch=1)
+    return _decode_incremental(
+        dec_model, params, cache, key, seq, jnp.asarray(start), length, top_k
+    )
+
+
+def _decode_setup(model, params, batch: int):
+    """(decode model, decode-layout params, fresh zeroed cache) for the
+    KV-cache paths. The cache skeleton comes from a trace-cached jitted
+    init (params creation inside init is dead-code-eliminated since only
+    the cache collection is returned), replicated on the params' mesh —
+    see _cache_init_fn."""
     import dataclasses
 
     from progen_tpu.models.progen import ProGen, unstack_params
@@ -242,26 +256,82 @@ def sample_fast(
         # decode mode is always unrolled (per-layer caches); convert the
         # scanned stacked layout
         params = unstack_params(params, model.config)
-
-    seq, start = _prepare_seq(model, prime, length, add_bos)
-
-    # cache skeleton: params creation inside init is dead-code-eliminated
-    # under jit since only the cache collection is returned. Replicated on
-    # the params' mesh (see _cache_init_fn) and trace-cached across calls.
     param_leaf = next(
-        (
-            l
-            for l in jax.tree.leaves(params)
-            if isinstance(l, jax.Array)
-        ),
+        (l for l in jax.tree.leaves(params) if isinstance(l, jax.Array)),
         None,
     )
     sharding = param_leaf.sharding if param_leaf is not None else None
     try:
-        init_fn = _cache_init_fn(dec_model, sharding)
+        init_fn = _cache_init_fn(dec_model, sharding, batch)
     except TypeError:  # unhashable sharding: fall back to uncached
-        init_fn = _cache_init_fn.__wrapped__(dec_model, sharding)
-    cache = init_fn()
-    return _decode_incremental(
-        dec_model, params, cache, key, seq, jnp.asarray(start), length, top_k
+        init_fn = _cache_init_fn.__wrapped__(dec_model, sharding, batch)
+    return dec_model, params, init_fn()
+
+
+@functools.partial(jax.jit, static_argnames=("model", "length", "top_k"))
+def _decode_incremental_batched(
+    model, params, cache, keys, seqs, start_pos, length, top_k
+):
+    """Batched KV-cache decode: seqs (B, length), keys (B,) — one
+    independent Gumbel stream per row, caches carry a leading batch axis
+    (they are built batch-shaped by the model's decode variables)."""
+
+    def feed(seqs, p, cache):
+        tok = jax.lax.dynamic_slice_in_dim(seqs, p, 1, axis=1)  # (B, 1)
+        logits, mut = model.apply(
+            {"params": params, "cache": cache}, tok, mutable=["cache"]
+        )
+        return logits[:, 0], mut["cache"]  # (B, vocab)
+
+    def prefill(p, cache):
+        _, cache = feed(seqs, p, cache)
+        return cache
+
+    cache = jax.lax.fori_loop(0, start_pos - 1, prefill, cache)
+
+    draw = jax.vmap(functools.partial(_gumbel_topk_step, top_k=top_k))
+
+    def gen(p, carry):
+        seqs, cache, keys = carry
+        logit, cache = feed(seqs, p, cache)
+        keys, sampled = draw(keys, logit)
+        seqs = jax.lax.dynamic_update_slice(
+            seqs, sampled[:, None].astype(seqs.dtype), (0, p + 1)
+        )
+        return seqs, cache, keys
+
+    seqs, _, _ = jax.lax.fori_loop(
+        start_pos - 1, length - 1, gen, (seqs, cache, keys)
+    )
+    after_eos = jnp.cumsum(seqs == 0, axis=-1) > 1
+    return seqs * (~after_eos)
+
+
+def sample_fast_batched(
+    key: jax.Array,
+    model,
+    params,
+    primes: jnp.ndarray,
+    length: int,
+    top_k: Optional[int] = 25,
+    add_bos: bool = False,
+) -> jnp.ndarray:
+    """Batched KV-cache decode: ``primes`` (batch, prime_len) ->
+    (batch, length), O(B·2w·d) attention per emitted step. Row i is
+    BIT-IDENTICAL to ``sample_fast(fold_in(key, i), ...)`` on that prime
+    (and therefore to ``sample_batched``'s row i) — same per-row Gumbel
+    streams, decoded together so the MXU sees batched matmuls instead of
+    batch-1 throwaway work."""
+    primes = jnp.asarray(primes, jnp.int32)
+    if primes.ndim != 2 or primes.shape[0] == 0:
+        raise ValueError(
+            f"primes must be (batch >= 1, prime_len), got {primes.shape}"
+        )
+    batch = primes.shape[0]
+    seqs, start = _prepare_seq(model, primes, length, add_bos)
+    dec_model, params, cache = _decode_setup(model, params, batch=batch)
+    keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(jnp.arange(batch))
+    return _decode_incremental_batched(
+        dec_model, params, cache, keys, seqs, jnp.asarray(start), length,
+        top_k,
     )
