@@ -355,7 +355,7 @@ def _decode_bench() -> dict:
 
     from progen_tpu.data.tokenizer import encode_tokens
     from progen_tpu.models.progen import ProGen
-    from progen_tpu.sampling import sample, sample_fast
+    from progen_tpu.sampling import sample, sample_fast, sample_fast_batched
 
     on_tpu = _is_tpu_platform(jax.devices()[0].platform)
     config = _load_config("tiny" if on_tpu else "smoke")
@@ -384,12 +384,25 @@ def _decode_bench() -> dict:
 
     fast_tps, fast_compile, out_fast = run(sample_fast)
     naive_tps, naive_compile, out_naive = run(sample)
+
+    # batched KV-cache decode: aggregate tokens/sec over a batch of primes
+    # through ONE shared cache loop (the MXU-throughput decode mode)
+    bsz = 8
+    primes_b = jnp.tile(prime[None], (bsz, 1))
+    batched_tps, _, _ = run(
+        lambda k, m, p, pr, ln, tk, ab: sample_fast_batched(
+            k, m, p, primes_b, ln, tk, ab
+        )
+    )
+    batched_tps *= bsz
     return {
         "phase": "decode-tiny",
         "config": "tiny" if on_tpu else "smoke",
         "kv_cache_tokens_per_sec": round(fast_tps, 1),
+        "kv_batched8_tokens_per_sec": round(batched_tps, 1),
         "naive_tokens_per_sec": round(naive_tps, 1),
         "speedup": round(fast_tps / naive_tps, 2),
+        "batch_scaling": round(batched_tps / fast_tps, 2),
         "bit_identical": bool(jnp.array_equal(out_fast, out_naive)),
         "gen_length": int(length - prime.shape[0] - 1),
         "compile_s": {

@@ -148,53 +148,26 @@ def sample_batched(
     reference is single-sequence only (utils.py:106) — batching the decode
     keeps the MXU busy on a mesh instead of wasting it on batch-1 matmuls.
     """
+    primes, batch, keys = _batched_primes_and_keys(key, primes)
+    seqs, start = _prepare_seq(model, primes, length, add_bos)
+    return jax.vmap(
+        lambda k, s: _decode(model, params, k, s, jnp.asarray(start), length, top_k)
+    )(keys, seqs)
+
+
+def _batched_primes_and_keys(key, primes):
+    """Shared batched-decode prep: validate (batch, prime_len) primes and
+    derive one independent Gumbel stream per row (fold of ``key``) — the
+    single source of the 'row i == single decode with fold_in(key, i)'
+    contract both batched decoders document."""
     primes = jnp.asarray(primes, jnp.int32)
     if primes.ndim != 2 or primes.shape[0] == 0:
         raise ValueError(
             f"primes must be (batch >= 1, prime_len), got {primes.shape}"
         )
     batch = primes.shape[0]
-    seqs, start = _prepare_seq(model, primes, length, add_bos)
     keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(jnp.arange(batch))
-    return jax.vmap(
-        lambda k, s: _decode(model, params, k, s, jnp.asarray(start), length, top_k)
-    )(keys, seqs)
-
-
-@functools.partial(jax.jit, static_argnames=("model", "length", "top_k"))
-def _decode_incremental(model, params, cache, key, seq, start_pos, length, top_k):
-    """Single fused decode: prefill the cache over the prime, then one
-    cache-backed forward per generated token."""
-
-    def feed(seq, p, cache):
-        tok = jax.lax.dynamic_slice(seq, (p,), (1,))[None]  # (1, 1)
-        logits, mut = model.apply(
-            {"params": params, "cache": cache}, tok, mutable=["cache"]
-        )
-        return logits[0, 0], mut["cache"]
-
-    def prefill(p, cache):
-        _, cache = feed(seq, p, cache)
-        return cache
-
-    cache = jax.lax.fori_loop(0, start_pos - 1, prefill, cache)
-
-    def gen(p, carry):
-        # feeding seq[p] (which may itself be a generated token — read from
-        # the CARRY, not the traced-in buffer) yields position p+1's logits
-        seq, cache, key = carry
-        logit, cache = feed(seq, p, cache)
-        key, sampled = _gumbel_topk_step(key, logit, top_k)
-        seq = jax.lax.dynamic_update_index_in_dim(
-            seq, sampled.astype(seq.dtype), p + 1, axis=0
-        )
-        return seq, cache, key
-
-    seq, _, _ = jax.lax.fori_loop(
-        start_pos - 1, length - 1, gen, (seq, cache, key)
-    )
-    after_eos = jnp.cumsum(seq == 0, axis=-1) > 1
-    return seq * (~after_eos)
+    return primes, batch, keys
 
 
 @functools.lru_cache(maxsize=8)
@@ -204,7 +177,7 @@ def _cache_init_fn(model, sharding, batch: int = 1):
     re-TRACING it every cadence. ``sharding`` is the params' mesh sharding,
     replicated: in multi-process runs a bare jit would commit the cache to
     each process's local device, which cannot be mixed with globally-sharded
-    params inside `_decode_incremental` (incompatible-devices error at the
+    params inside the decode loop (incompatible-devices error at the
     first cadenced sample). Shardings and flax modules both hash by value,
     so the cache key is stable across calls."""
     out_shardings = None
@@ -236,9 +209,15 @@ def sample_fast(
     # validate before the (comparatively) expensive cache-init compile
     seq, start = _prepare_seq(model, prime, length, add_bos)
     dec_model, params, cache = _decode_setup(model, params, batch=1)
-    return _decode_incremental(
-        dec_model, params, cache, key, seq, jnp.asarray(start), length, top_k
+    # the single decode IS the batched kernel at B=1 (row key = the raw
+    # key, preserving this function's historical stream); vmapped PRNG
+    # draws are bitwise equal to unbatched ones, which the batched-row
+    # parity tests pin empirically
+    out = _decode_incremental_batched(
+        dec_model, params, cache, key[None], seq[None],
+        jnp.asarray(start), length, top_k,
     )
+    return out[0]
 
 
 def _decode_setup(model, params, batch: int):
@@ -322,15 +301,9 @@ def sample_fast_batched(
     (and therefore to ``sample_batched``'s row i) — same per-row Gumbel
     streams, decoded together so the MXU sees batched matmuls instead of
     batch-1 throwaway work."""
-    primes = jnp.asarray(primes, jnp.int32)
-    if primes.ndim != 2 or primes.shape[0] == 0:
-        raise ValueError(
-            f"primes must be (batch >= 1, prime_len), got {primes.shape}"
-        )
-    batch = primes.shape[0]
+    primes, batch, keys = _batched_primes_and_keys(key, primes)
     seqs, start = _prepare_seq(model, primes, length, add_bos)
     dec_model, params, cache = _decode_setup(model, params, batch=batch)
-    keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(jnp.arange(batch))
     return _decode_incremental_batched(
         dec_model, params, cache, keys, seqs, jnp.asarray(start), length,
         top_k,

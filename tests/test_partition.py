@@ -261,3 +261,55 @@ class TestLargeConfigHbmFit:
         assert per_chip < 4 * 2**30, f"per-chip state {per_chip/2**30:.2f} GB"
         # and sharding must not LOSE anything: per-chip x 8 >= total
         assert per_chip * 8 >= total
+
+
+class TestHybridMultiSliceMesh:
+    """make_mesh's DCN x ICI branch: devices spanning multiple slices must
+    lay the data axis OVER slices (gradient all-reduce rides DCN once per
+    step) and keep seq/model intra-slice (halo/TP collectives ride ICI).
+    Fake v5e-shaped devices carry the attributes mesh_utils consults."""
+
+    class FakeDev:
+        def __init__(self, i, s):
+            self.id = i
+            self.slice_index = s
+            self.platform = "tpu"
+            self.process_index = s
+            self.device_kind = "fake-tpu"
+            local = i % 4
+            self.coords = (local % 2, local // 2, 0)
+            self.core_on_chip = 0
+
+        def __repr__(self):
+            return f"D{self.id}s{self.slice_index}"
+
+    def _slice_devices(self, n_slices, per_slice=4):
+        return [
+            self.FakeDev(i, i // per_slice)
+            for i in range(n_slices * per_slice)
+        ]
+
+    def test_two_slices_data_over_dcn(self):
+        mesh = make_mesh(
+            data=2, seq=2, model=2, devices=self._slice_devices(2)
+        )
+        assert dict(mesh.shape) == {"data": 2, "seq": 2, "model": 2}
+        arr = mesh.devices
+        for i in range(2):
+            row_slices = {d.slice_index for d in arr[i].flat}
+            assert row_slices == {i}, (
+                f"data row {i} spans slices {row_slices}; seq/model "
+                "collectives would cross DCN"
+            )
+
+    def test_four_slices_pure_dp(self):
+        # 4 slices x 2 chips, all on the data axis: DCN outermost means
+        # consecutive data rows group by slice (row i -> slice i // 2)
+        mesh = make_mesh(data=8, devices=self._slice_devices(4, 2))
+        assert dict(mesh.shape) == {"data": 8, "seq": 1, "model": 1}
+        arr = mesh.devices
+        for i in range(8):
+            (dev,) = arr[i].flat
+            assert dev.slice_index == i // 2, (
+                f"data row {i} on slice {dev.slice_index}"
+            )
