@@ -1,0 +1,33 @@
+"""Checkpoint migration CLI — reference pickle -> native sharded format.
+
+Run: python -m progen_tpu.cli.convert --src ./old/ckpt_1690000000.pkl \
+         --dest ./ckpts
+
+The written checkpoint resumes directly in `cli.train` (config + progress
+carried over; Adam moments re-warm — see progen_tpu/convert.py) and
+samples directly in `cli.sample`.
+"""
+
+from __future__ import annotations
+
+from progen_tpu.utils.env import load_env_file
+
+load_env_file()  # XLA/env flags before jax import (ref train.py:1-2)
+
+import click
+
+
+@click.command()
+@click.option("--src", required=True,
+              help="reference ckpt_*.pkl (cloudpickle package)")
+@click.option("--dest", default="./ckpts",
+              help="native checkpoint directory to write into")
+def main(src, dest):
+    from progen_tpu.convert import convert_checkpoint
+
+    written = convert_checkpoint(src, dest)
+    print(f"converted {src} -> {written}")
+
+
+if __name__ == "__main__":
+    main()

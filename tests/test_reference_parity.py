@@ -43,62 +43,9 @@ CFG = ProGenConfig(
 )
 
 
-def transplant(ref_params, depth: int) -> dict:
-    """Map the reference's haiku param tree into this repo's flax tree.
-
-    Orientations match throughout: hk.Linear w is (in, out) like flax
-    kernel; SGU spatial weights are (out_pos, in_pos) in both (einsum
-    'n d, m n -> m d' there, '...nd,mn->...md' here)."""
-    P = "pro_gen_base/~"
-    g = lambda mod, name: np.asarray(ref_params[f"{P}/{mod}"][name])
-
-    out = {
-        "embed": {"embedding": g("embed", "embeddings")},
-        "ScaleNorm_0": {"norm": {"scale": g("layer_norm", "scale")}},
-        "to_logits": {
-            "kernel": g("linear", "w"),
-            "bias": g("linear", "b"),
-        },
-    }
-    for i in range(depth):
-        out[f"attn{i}"] = {
-            "ScaleNorm_0": {
-                "norm": {"scale": g(f"attn{i}/~/layer_norm", "scale")}
-            },
-            "to_qkv": {"kernel": g(f"attn{i}/~/linear", "w")},
-            "to_out": {
-                "kernel": g(f"attn{i}/~/linear_1", "w"),
-                "bias": g(f"attn{i}/~/linear_1", "b"),
-            },
-        }
-        ff = {
-            "ScaleNorm_0": {
-                "norm": {"scale": g(f"ff{i}/~/layer_norm", "scale")}
-            },
-            "proj_in": {
-                "kernel": g(f"ff{i}/~/linear", "w"),
-                "bias": g(f"ff{i}/~/linear", "b"),
-            },
-            "proj_out": {
-                "kernel": g(f"ff{i}/~/linear_1", "w"),
-                "bias": g(f"ff{i}/~/linear_1", "b"),
-            },
-        }
-        sgu_key = f"{P}/ff{i}/~/sgu"
-        if sgu_key in ref_params:
-            ff["sgu"] = {
-                "ScaleNorm_0": {
-                    "norm": {"scale": g(f"ff{i}/~/sgu/~/layer_norm", "scale")}
-                },
-                "spatial_weights": g(f"ff{i}/~/sgu", "spatial_weights"),
-                "spatial_biases": g(f"ff{i}/~/sgu", "spatial_biases"),
-                "proj_out": {
-                    "kernel": g(f"ff{i}/~/sgu/~/linear", "w"),
-                    "bias": g(f"ff{i}/~/sgu/~/linear", "b"),
-                },
-            }
-        out[f"ff{i}"] = ff
-    return out
+# the production migration mapping (progen_tpu/convert.py) IS the tested
+# transplant — these tests are its parity lock
+from progen_tpu.convert import reference_params_to_flax as transplant
 
 
 @pytest.mark.skipif(RefProGen is None, reason="reference tree not importable")
@@ -250,4 +197,69 @@ class TestReferenceParity:
         )[0]
         np.testing.assert_allclose(
             np.asarray(logits), np.asarray(ref_logits), atol=2e-4, rtol=2e-4
+        )
+
+
+@pytest.mark.skipif(RefProGen is None, reason="reference tree not importable")
+class TestCheckpointMigration:
+    def test_converted_checkpoint_samples_identically(self, tmp_path):
+        """End-to-end migration: a real reference ckpt_*.pkl (cloudpickled
+        package, checkpoint.py:25-31) converts into a native checkpoint
+        that restores through the normal path and produces the reference's
+        logits — the switching story for reference users."""
+        import pickle
+
+        from progen_tpu.checkpoint import get_checkpoint_fns
+        from progen_tpu.convert import convert_checkpoint
+
+        ref_model = RefProGen(
+            num_tokens=CFG.num_tokens, dim=CFG.dim, depth=CFG.depth,
+            window_size=CFG.window_size,
+            global_mlp_depth=CFG.global_mlp_depth, heads=CFG.heads,
+            dim_head=CFG.dim_head, ff_mult=CFG.ff_mult,
+            seq_len=CFG.seq_len, shift_tokens=True, ff_glu=True,
+        )
+        rng = jax.random.PRNGKey(0)
+        seq = jax.random.randint(
+            jax.random.PRNGKey(1), (CFG.seq_len,), 0, CFG.num_tokens
+        ).astype(jnp.uint8)
+        ref_params = ref_model.init(rng, seq)
+        ref_logits = np.asarray(ref_model.apply(ref_params, rng, seq))
+
+        # a reference checkpoint file, exactly as train.py:196-204 writes it
+        src = tmp_path / "ckpt_1700000000.pkl"
+        package = {
+            "next_seq_index": 4096,
+            "params": jax.tree.map(np.asarray, dict(ref_params)),
+            "optim_state": None,  # not migrated (see convert.py docstring)
+            "model_config": {
+                "num_tokens": CFG.num_tokens, "dim": CFG.dim,
+                "depth": CFG.depth, "window_size": CFG.window_size,
+                "global_mlp_depth": CFG.global_mlp_depth,
+                "heads": CFG.heads, "dim_head": CFG.dim_head,
+                "ff_mult": CFG.ff_mult, "seq_len": CFG.seq_len,
+                "dtype": "float32",
+            },
+            "run_id": "ref-run-7",
+        }
+        with open(src, "wb") as f:
+            pickle.dump(package, f)
+
+        dest = tmp_path / "native"
+        written = convert_checkpoint(str(src), str(dest))
+        assert written.startswith(str(dest))
+
+        # restore through the NORMAL path (what cli.sample does)
+        _, get_last, _ = get_checkpoint_fns(str(dest))
+        pkg = get_last.restore_params()
+        assert pkg.next_seq_index == 4096 and pkg.run_id == "ref-run-7"
+        restored_cfg = ProGenConfig.from_dict(pkg.model_config)
+        assert restored_cfg == CFG
+
+        ours = ProGen(restored_cfg)
+        logits = ours.apply(
+            {"params": pkg.state}, jnp.asarray(seq, jnp.int32)[None]
+        )[0]
+        np.testing.assert_allclose(
+            np.asarray(logits), ref_logits, atol=2e-4, rtol=2e-4
         )
