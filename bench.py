@@ -72,6 +72,7 @@ _PHASES = (
     ("decode-tiny", 600),
     ("train-default", 600),
     ("train-base", 720),
+    ("sgu-mix", 420),  # last: micro-bench, lowest priority under budget
 )
 
 # per-config bench recipes: (grad_accum, micro_batch, iters)
@@ -344,6 +345,63 @@ def _kernel_bench(window: int) -> dict:
     }
 
 
+def _sgu_mix_bench() -> dict:
+    """Dense tril-masked vs recursive block-triangular SGU mix at the
+    long8k shapes, fwd+bwd — isolates the sgu_block_size optimization
+    (the long8k train phases both run with it on)."""
+    import jax
+    import jax.numpy as jnp
+
+    from progen_tpu.ops.sgu import causal_sgu_mix
+
+    on_tpu = _is_tpu_platform(jax.devices()[0].platform)
+    n, d_half, b = (8192, 1024, 2) if on_tpu else (256, 64, 1)
+    block = 1024 if on_tpu else 32
+    iters = 10 if on_tpu else 3
+    gate = jax.random.normal(jax.random.PRNGKey(0), (b, n, d_half),
+                             jnp.bfloat16)
+    w = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.float32) / n
+    bias = jnp.ones((n, 1), jnp.float32)
+
+    def timed(block_size, bwd):
+        if bwd:
+            fn = jax.jit(
+                jax.grad(
+                    lambda g, w: causal_sgu_mix(g, w, bias, block_size)
+                    .astype(jnp.float32).sum(),
+                    argnums=(0, 1),
+                )
+            )
+        else:
+            fn = jax.jit(
+                lambda g, w: causal_sgu_mix(g, w, bias, block_size)
+            )
+        out = jax.block_until_ready(fn(gate, w))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(gate, w)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters
+
+    t_dense_f, t_block_f = timed(0, False), timed(block, False)
+    t_dense_b, t_block_b = timed(0, True), timed(block, True)
+    return {
+        "phase": "sgu-mix",
+        "shape": f"b{b} n{n} d{d_half} block{block}",
+        "fwd_ms": {
+            "dense": round(t_dense_f * 1e3, 3),
+            "blocked": round(t_block_f * 1e3, 3),
+        },
+        "bwd_ms": {
+            "dense": round(t_dense_b * 1e3, 3),
+            "blocked": round(t_block_b * 1e3, 3),
+        },
+        "fwd_speedup": round(t_dense_f / t_block_f, 2),
+        "bwd_speedup": round(t_dense_b / t_block_b, 2),
+        "platform": jax.devices()[0].platform,
+    }
+
+
 def _decode_bench() -> dict:
     """Autoregressive decode throughput on the flagship config (BASELINE.md
     config 5): the KV-cache fused decode (sample_fast) vs the
@@ -483,6 +541,8 @@ def run_phase(name: str) -> dict:
         return _train_bench(name[len("train-"):])
     if name == "decode-tiny":
         return _decode_bench()
+    if name == "sgu-mix":
+        return _sgu_mix_bench()
     if name == "large-projection":
         return _large_projection()
     raise ValueError(f"unknown phase {name}")
@@ -622,7 +682,7 @@ def main() -> None:
         ph = res.get("phase", "?")
         if "error" in res:
             summary[ph] = res["error"][:60]
-        elif ph.startswith("kernel"):
+        elif ph.startswith("kernel") or ph == "sgu-mix":
             summary[ph] = {
                 "fwd_speedup": res["fwd_speedup"],
                 "bwd_speedup": res["bwd_speedup"],
