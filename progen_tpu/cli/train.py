@@ -59,6 +59,8 @@ def confirm(question: str) -> bool:
 @click.option("--mesh_seq", default=1, help="sequence-parallel mesh axis size")
 @click.option("--mesh_model", default=1, help="tensor-parallel mesh axis size")
 @click.option("--num_steps", default=0, help="stop after N optimizer steps (0 = full data)")
+@click.option("--epochs", default=1,
+              help="passes over the training data (reference semantics: 1)")
 @click.option("--profile_dir", default="", help="jax.profiler trace dir for steps 2-4")
 @click.option("--hardware_rng", default=False, is_flag=True,
               help="TPU-fast partitionable rbg PRNG (ref: set_hardware_rng_)")
@@ -100,6 +102,7 @@ def main(
     mesh_seq,
     mesh_model,
     num_steps,
+    epochs,
     profile_dir,
     hardware_rng,
     naive_sample,
@@ -286,7 +289,11 @@ def main(
     )
     import math
 
-    seq_indices = range(start_seq_index, num_train, effective_batch)
+    # reference parity is ONE pass over the data (train.py:179); --epochs
+    # extends the same record-index bookkeeping across passes (the data
+    # iterator's skip/loop indices are global across epochs)
+    num_total = num_train * max(epochs, 1)
+    seq_indices = range(start_seq_index, num_total, effective_batch)
     steps_done = 0
     profiler_active = False
     # metric step continues across resumes (state.step is checkpointed);
@@ -325,7 +332,7 @@ def main(
             # dispatch): host input pipeline overlaps device compute —
             # skipped when this was the last step
             is_last = (num_steps and steps_done >= num_steps) or (
-                seq_index + effective_batch >= num_train
+                seq_index + effective_batch >= num_total
             )
             if not is_last:
                 batch = next_super_batch()

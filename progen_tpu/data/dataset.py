@@ -116,9 +116,7 @@ def iterator_from_tfrecords_folder(
     filenames = sorted(filenames, key=_sort_key)
     num_seqs = sum(count_from_filename(f) for f in filenames)
 
-    def record_stream() -> Iterator[bytes]:
-        for path in filenames:
-            yield from read_tfrecords(path)
+    file_counts = [count_from_filename(f) for f in filenames]
 
     def iter_fn(
         seq_len: int,
@@ -141,20 +139,42 @@ def iterator_from_tfrecords_folder(
         local_bs = batch_size // process_count
 
         def batches() -> Iterator[np.ndarray]:
+            # The record index is GLOBAL across passes, so ``skip`` resumes
+            # into the right epoch (a resume index may exceed one epoch's
+            # record count under --epochs) and later passes replay the FULL
+            # stream instead of re-applying the skip every epoch.
+            #
+            # loop=True is a CONTINUOUS stream: the buffer carries across
+            # the rewind, so every batch is full and covers exactly records
+            # [k*batch, (k+1)*batch) of the looped stream — record
+            # bookkeeping (checkpoint resume) is exact for any epoch count,
+            # and batch shapes stay static (no ragged-tail recompiles on
+            # TPU; a deliberate delta from the reference's tail batch,
+            # which loop=False preserves).
+            #
+            # Resume fast-forward pays no IO for completed passes (the
+            # stream is periodic) and none for whole files below ``skip``
+            # (counts come from the filename contract).
+            gidx = (skip // num_seqs) * num_seqs if (loop and num_seqs) else 0
+            buf: List[bytes] = []
             while True:
-                buf: List[bytes] = []
-                for gidx, rec in enumerate(record_stream()):
-                    if gidx < skip:
+                for path, cnt in zip(filenames, file_counts):
+                    if gidx + cnt <= skip:
+                        gidx += cnt  # whole file before the skip: no read
                         continue
-                    if gidx % process_count != process_index:
-                        continue
-                    buf.append(rec)
-                    if len(buf) == local_bs:
-                        yield collate(buf, seq_len)
-                        buf = []
-                if buf:  # ragged tail batch (reference yields it too)
-                    yield collate(buf, seq_len)
+                    for rec in read_tfrecords(path):
+                        idx, gidx = gidx, gidx + 1
+                        if idx < skip:
+                            continue
+                        if idx % process_count != process_index:
+                            continue
+                        buf.append(rec)
+                        if len(buf) == local_bs:
+                            yield collate(buf, seq_len)
+                            buf = []
                 if not loop:
+                    if buf:  # ragged tail (the reference yields it too)
+                        yield collate(buf, seq_len)
                     return
 
         return _prefetch(batches(), prefetch)

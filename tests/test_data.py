@@ -171,6 +171,29 @@ class TestIterator:
         b1, b2 = next(it), next(it)
         np.testing.assert_array_equal(b1, b2)
 
+    def test_skip_is_global_across_epochs(self, tmp_path):
+        """Multi-epoch semantics (--epochs): skip counts records over the
+        WHOLE looped stream, so (a) a resume index beyond one epoch lands
+        in the right later pass, and (b) passes after the skip replay the
+        FULL stream instead of re-applying the skip each epoch."""
+        seqs = _write_shards(tmp_path)  # 12 records
+        n, iter_fn = iterator_from_tfrecords_folder(str(tmp_path))
+        assert n == 12
+
+        # (a) skip 15 = epoch 1 (12) + 3: first row is epoch-2 record 3
+        it = iter_fn(seq_len=16, batch_size=4, skip=15, loop=True)
+        rows = [r for _ in range(2) for r in next(it)]
+        assert decode_tokens(rows[0]) == seqs[3].decode()
+
+        # (b) skip 5, one full epoch of remaining 7 rows, then epoch 2
+        # starts from record 0 (not 5)
+        it = iter_fn(seq_len=16, batch_size=4, skip=5, loop=True)
+        rows = []
+        while len(rows) < 9:
+            rows.extend(decode_tokens(r) for r in next(it))
+        assert rows[:7] == [s.decode() for s in seqs[5:]]
+        assert rows[7:9] == [s.decode() for s in seqs[:2]]
+
 
 FASTA = """>UniRef50_A0A009 Uncharacterized protein n=1 Tax=Acinetobacter TaxID=1310605 RepID=X
 MGHKLV
@@ -268,3 +291,40 @@ class TestResumeContracts:
             for r in b
         ]
         assert rows_bs4 == rows_bs3 == [s.decode() for s in seqs[6:]]
+
+    def test_loop_stream_is_continuous_full_batches(self, tmp_path):
+        """loop=True: the buffer carries across the rewind — every batch is
+        FULL (static shapes on TPU) and batch k covers records
+        [k*b, (k+1)*b) of the periodic stream, making resume bookkeeping
+        exact for any epoch count."""
+        seqs = _write_shards(tmp_path)  # 12 records
+        _, iter_fn = iterator_from_tfrecords_folder(str(tmp_path))
+        it = iter_fn(seq_len=16, batch_size=5, loop=True)  # 12 % 5 != 0
+        rows = []
+        for _ in range(5):  # 25 rows = 2 passes + 1
+            b = next(it)
+            assert b.shape[0] == 5  # never ragged under loop
+            rows.extend(decode_tokens(r) for r in b)
+        expect = [s.decode() for s in seqs]
+        assert rows == (expect * 3)[:25]
+
+    def test_resume_fast_forward_skips_file_reads(self, tmp_path, monkeypatch):
+        """Whole files below the skip point (and all completed passes) are
+        fast-forwarded from the filename counts without decoding."""
+        import progen_tpu.data.dataset as ds
+
+        seqs = _write_shards(tmp_path)  # 3 files x 4 records
+        opened = []
+        real = ds.read_tfrecords
+        monkeypatch.setattr(
+            ds, "read_tfrecords",
+            lambda p: opened.append(p) or real(p),
+        )
+        _, iter_fn = iterator_from_tfrecords_folder(str(tmp_path))
+        # skip = 2 full passes (24) + first file (4) + 1 -> only files 1+
+        # of pass 2 are read
+        it = iter_fn(seq_len=16, batch_size=4, skip=29, loop=True)
+        first = next(it)
+        assert decode_tokens(first[0]) == seqs[5].decode()
+        assert len(opened) >= 1
+        assert all("0.4.train" not in p for p in opened[:1])
