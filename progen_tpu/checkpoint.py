@@ -41,12 +41,18 @@ DEFAULT_KEEP_LAST_N = 500  # reference default, train.py:48
 
 
 class Package(NamedTuple):
-    """What one checkpoint holds — reference schema, train.py:196-202."""
+    """What one checkpoint holds — reference schema, train.py:196-202,
+    plus ``train_config``: optimizer-structure-affecting run settings
+    (lr schedule etc.). Resume must rebuild the optimizer EXACTLY as
+    saved — a schedule mismatch changes the optax state pytree and the
+    sharded restore fails structurally — so these ride the checkpoint the
+    same way the model config does."""
 
     next_seq_index: int
     state: Any  # TrainState (params + opt_state + step)
     model_config: dict
     run_id: Optional[str]
+    train_config: Optional[dict] = None
 
 
 def _is_gcs(path: str) -> bool:
@@ -194,6 +200,7 @@ def get_checkpoint_fns(
             "next_seq_index": int(package.next_seq_index),
             "model_config": package.model_config,
             "run_id": package.run_id,
+            "train_config": package.train_config,
         }
         if async_save:
             if "ckptr" not in _async:
@@ -236,6 +243,7 @@ def get_checkpoint_fns(
             state=state,
             model_config=meta["model_config"],
             run_id=meta["run_id"],
+            train_config=meta.get("train_config"),
         )
 
     def restore_params(abstract_params: Any = None) -> Optional[Package]:
@@ -270,6 +278,7 @@ def get_checkpoint_fns(
             state=restored["params"],
             model_config=meta["model_config"],
             run_id=meta["run_id"],
+            train_config=meta.get("train_config"),
         )
 
     get_last.restore_params = restore_params
@@ -287,6 +296,7 @@ def get_checkpoint_fns(
             state=None,
             model_config=meta["model_config"],
             run_id=meta["run_id"],
+            train_config=meta.get("train_config"),
         )
 
     get_last.peek = peek_last  # exposed without widening the triple

@@ -61,6 +61,12 @@ def confirm(question: str) -> bool:
 @click.option("--num_steps", default=0, help="stop after N optimizer steps (0 = full data)")
 @click.option("--epochs", default=1,
               help="passes over the training data (reference semantics: 1)")
+@click.option("--lr_schedule", default="constant",
+              type=click.Choice(["constant", "cosine"]),
+              help="constant (reference parity) or warmup+cosine decay "
+                   "over the whole run")
+@click.option("--warmup_steps", default=0,
+              help="linear warmup steps for --lr_schedule cosine")
 @click.option("--profile_dir", default="", help="jax.profiler trace dir for steps 2-4")
 @click.option("--hardware_rng", default=False, is_flag=True,
               help="TPU-fast partitionable rbg PRNG (ref: set_hardware_rng_)")
@@ -103,6 +109,8 @@ def main(
     mesh_model,
     num_steps,
     epochs,
+    lr_schedule,
+    warmup_steps,
     profile_dir,
     hardware_rng,
     naive_sample,
@@ -170,7 +178,35 @@ def main(
          else model_kwargs.get("dtype", "float32")}
     )
 
-    optimizer = make_optimizer(learning_rate, weight_decay, max_grad_norm)
+    # --- optimizer structure follows the checkpoint on resume: a schedule
+    # mismatch would change the optax state pytree and break the sharded
+    # restore, so train_config overrides the flags like model_config does
+    saved_tc = getattr(last_meta, "train_config", None) if last_meta else None
+    total_steps = 0
+    if saved_tc:
+        lr_schedule = saved_tc.get("lr_schedule", lr_schedule)
+        warmup_steps = saved_tc.get("warmup_steps", warmup_steps)
+        total_steps = saved_tc.get("total_steps", 0)
+    if lr_schedule == "cosine" and not total_steps:
+        # the cosine horizon needs the run length; the counts come from the
+        # filename contract, so this early peek costs one glob
+        n_total, _ = iterator_from_tfrecords_folder(data_path)
+        total_steps = max(
+            (n_total * max(epochs, 1)) // (batch_size * grad_accum_every), 1
+        )
+        if num_steps:
+            # a capped run decays over the steps that will actually happen
+            total_steps = min(total_steps, num_steps)
+    optimizer = make_optimizer(
+        learning_rate, weight_decay, max_grad_norm,
+        schedule=lr_schedule, warmup_steps=warmup_steps,
+        total_steps=total_steps,
+    )
+    train_config = {
+        "lr_schedule": lr_schedule,
+        "warmup_steps": warmup_steps,
+        "total_steps": total_steps,
+    }
 
     # --- mesh: data_parallel -> absorb all devices on the data axis
     if mesh_data == 0:
@@ -363,6 +399,7 @@ def main(
                         state=state,
                         model_config=config.to_dict(),
                         run_id=run_id,
+                        train_config=train_config,
                     )
                 )
             if i % validate_every == 0:
@@ -421,6 +458,7 @@ def main(
             state=state,
             model_config=config.to_dict(),
             run_id=run_id,
+            train_config=train_config,
         )
     )
     save_ckpt.close()  # async mode: publish the final save before exit

@@ -29,12 +29,41 @@ def make_optimizer(
     learning_rate: float = 2e-4,
     weight_decay: float = 1e-3,
     max_grad_norm: float = 0.5,
+    *,
+    schedule: str = "constant",
+    warmup_steps: int = 0,
+    total_steps: int = 0,
 ) -> optax.GradientTransformation:
+    """``schedule``: "constant" (reference parity — train.py:116 uses a
+    fixed lr) or "cosine" (linear warmup over ``warmup_steps`` then cosine
+    decay to 10% of peak at ``total_steps``; requires total_steps > 0).
+    The schedule is resume-exact: it is a pure function of the optimizer
+    step count, which the checkpointed Adam state carries."""
+    lr = _make_schedule(learning_rate, schedule, warmup_steps, total_steps)
     return optax.chain(
         optax.clip_by_global_norm(max_grad_norm),
         optax.adamw(
-            learning_rate,
+            lr,
             weight_decay=weight_decay,
             mask=weight_decay_mask,
         ),
     )
+
+
+def _make_schedule(peak, schedule, warmup_steps, total_steps):
+    if schedule == "constant":
+        return peak
+    if schedule == "cosine":
+        if total_steps <= warmup_steps:
+            raise ValueError(
+                f"cosine schedule needs total_steps ({total_steps}) > "
+                f"warmup_steps ({warmup_steps})"
+            )
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=peak,
+            warmup_steps=warmup_steps,
+            decay_steps=total_steps,
+            end_value=0.1 * peak,
+        )
+    raise ValueError(f"unknown schedule {schedule!r}")

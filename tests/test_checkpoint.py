@@ -267,3 +267,22 @@ class TestAsyncSave:
         save(Package(10, state, TINY.to_dict(), "r"))
         save.close()
         assert get_last.peek().next_seq_index == 10
+
+
+class TestTrainConfigPersistence:
+    def test_round_trips_and_defaults_none(self, setup, tmp_path):
+        """train_config (lr schedule etc.) rides the checkpoint metadata so
+        resume rebuilds the optimizer with the saved structure; old
+        checkpoints without the key read back as None."""
+        _, _, state, _, _ = setup
+        _, get_last, save = get_checkpoint_fns(str(tmp_path / "c"))
+        tc = {"lr_schedule": "cosine", "warmup_steps": 5, "total_steps": 40}
+        save(Package(1, state, TINY.to_dict(), "r", train_config=tc))
+        pkg = get_last.peek()
+        assert pkg.train_config == tc
+        assert get_last.restore_params().train_config == tc
+
+        # a package without the field (positional 4-tuple call sites,
+        # convert.py) stays None
+        save(Package(2, state, TINY.to_dict(), "r"))
+        assert get_last.peek().train_config is None

@@ -100,3 +100,44 @@ def test_train_missing_config_errors(workspace, tmp_path, monkeypatch):
         train_main, ["--config_path", str(tmp_path / "nope")]
     )
     assert res.exit_code != 0
+
+
+def test_combined_features_loop(workspace, monkeypatch):
+    """All round-3 features in ONE run — ring attention over a seq-sharded
+    mesh, cosine LR schedule, multi-epoch, async checkpointing, KV-cache
+    cadenced sampling — then a flagless resume that reconstructs the
+    scheduled optimizer and mesh-independent state from the checkpoint."""
+    monkeypatch.chdir(workspace)
+    runner = CliRunner()
+
+    from progen_tpu.cli.train import main as train_main
+
+    ckpts = workspace / "ckpts_combined"
+    args = [
+        "--wandb_off", "--batch_size", "4", "--grad_accum_every", "1",
+        "--epochs", "2", "--num_steps", "3",
+        "--lr_schedule", "cosine", "--warmup_steps", "1",
+        "--mesh_data", "2", "--mesh_seq", "2", "--ring_attn",
+        "--async_checkpoint",
+        "--validate_every", "1000", "--sample_every", "2",
+        "--checkpoint_every", "1000", "--seq_len", "32",
+        "--config_path", str(workspace / "configs" / "model"),
+        "--data_path", str(workspace / "train_data"),
+        "--checkpoint_path", str(ckpts),
+    ]
+    res = runner.invoke(train_main, args)
+    assert res.exit_code == 0, res.output
+    assert "loss:" in res.output and "sample:" in res.output
+
+    # flagless resume: schedule + config come from the checkpoint
+    res = runner.invoke(train_main, [
+        "--wandb_off", "--batch_size", "4", "--grad_accum_every", "1",
+        "--num_steps", "1", "--validate_every", "1000",
+        "--sample_every", "1000", "--checkpoint_every", "1000",
+        "--seq_len", "32",
+        "--config_path", str(workspace / "configs" / "model"),
+        "--data_path", str(workspace / "train_data"),
+        "--checkpoint_path", str(ckpts),
+    ])
+    assert res.exit_code == 0, res.output
+    assert "loss:" in res.output
