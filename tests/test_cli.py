@@ -141,3 +141,44 @@ def test_combined_features_loop(workspace, monkeypatch):
     ])
     assert res.exit_code == 0, res.output
     assert "loss:" in res.output
+
+
+def test_eval_cli(workspace, monkeypatch):
+    """Offline eval: mean per-sequence loss + perplexity over a split from
+    the latest checkpoint (uses the checkpoints the train test wrote)."""
+    monkeypatch.chdir(workspace)
+    runner = CliRunner()
+
+    from progen_tpu.cli.eval import main as eval_main
+
+    if not (workspace / "ckpts").exists():  # standalone-selection safety
+        from progen_tpu.cli.generate_data import main as gen_main
+        from progen_tpu.cli.train import main as train_main
+
+        if not (workspace / "train_data").exists():
+            res = runner.invoke(
+                gen_main, ["--data_dir", str(workspace / "configs" / "data")]
+            )
+            assert res.exit_code == 0, res.output
+
+        res = runner.invoke(train_main, [
+            "--wandb_off", "--batch_size", "4", "--grad_accum_every", "1",
+            "--num_steps", "1", "--validate_every", "1000",
+            "--sample_every", "1000", "--checkpoint_every", "1000",
+            "--seq_len", "32",
+            "--config_path", str(workspace / "configs" / "model"),
+            "--data_path", str(workspace / "train_data"),
+            "--checkpoint_path", str(workspace / "ckpts"),
+        ])
+        assert res.exit_code == 0, res.output
+
+    res = runner.invoke(eval_main, [
+        "--checkpoint_path", str(workspace / "ckpts"),
+        "--data_path", str(workspace / "train_data"),
+        "--split", "valid", "--batch_size", "4",
+    ])
+    assert res.exit_code == 0, res.output
+    assert "perplexity:" in res.output
+    loss = float(res.output.split("loss: ")[1].split()[0])
+    ppl = float(res.output.split("perplexity: ")[1].split()[0])
+    np.testing.assert_allclose(ppl, np.exp(loss), rtol=1e-4)
