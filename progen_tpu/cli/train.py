@@ -67,6 +67,9 @@ def confirm(question: str) -> bool:
                    "over the whole run")
 @click.option("--warmup_steps", default=0,
               help="linear warmup steps for --lr_schedule cosine")
+@click.option("--shuffle_seed", default=None, type=int,
+              help="deterministic per-epoch training-data reshuffle "
+                   "(resume-exact; unset = ETL order, reference parity)")
 @click.option("--profile_dir", default="", help="jax.profiler trace dir for steps 2-4")
 @click.option("--hardware_rng", default=False, is_flag=True,
               help="TPU-fast partitionable rbg PRNG (ref: set_hardware_rng_)")
@@ -111,6 +114,7 @@ def main(
     epochs,
     lr_schedule,
     warmup_steps,
+    shuffle_seed,
     profile_dir,
     hardware_rng,
     naive_sample,
@@ -187,6 +191,9 @@ def main(
         lr_schedule = saved_tc.get("lr_schedule", lr_schedule)
         warmup_steps = saved_tc.get("warmup_steps", warmup_steps)
         total_steps = saved_tc.get("total_steps", 0)
+        # data order must also survive a flagless resume: the resume skip
+        # indexes the SHUFFLED stream, so the seed rides the checkpoint
+        shuffle_seed = saved_tc.get("shuffle_seed", shuffle_seed)
     if lr_schedule == "cosine" and not total_steps:
         # the cosine horizon needs the run length; the counts come from the
         # filename contract, so this early peek costs one glob
@@ -206,6 +213,7 @@ def main(
         "lr_schedule": lr_schedule,
         "warmup_steps": warmup_steps,
         "total_steps": total_steps,
+        "shuffle_seed": shuffle_seed,
     }
 
     # --- mesh: data_parallel -> absorb all devices on the data axis
@@ -269,6 +277,7 @@ def main(
         batch_size,
         skip=start_seq_index,
         loop=True,
+        shuffle_seed=shuffle_seed,
         **proc_kwargs,
     )
     valid_ds = valid_iter_fn(

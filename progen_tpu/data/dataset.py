@@ -126,15 +126,31 @@ def iterator_from_tfrecords_folder(
         process_index: int = 0,
         process_count: int = 1,
         prefetch: int = 2,
+        shuffle_seed: int | None = None,
     ) -> Iterator[np.ndarray]:
         """Yield (batch_size, seq_len+1) int32 batches of this process's
         shard. ``skip``/``batch_size`` are GLOBAL record counts; each process
         keeps records with global_index % process_count == process_index and
-        yields its batch_size/process_count slice of every global batch."""
+        yields its batch_size/process_count slice of every global batch.
+
+        ``shuffle_seed``: deterministic per-pass reshuffle — pass e draws
+        permutation ``default_rng((seed, e))``, so every process computes
+        the identical order and the global record-index bookkeeping (skip /
+        resume) stays exact: index k of the shuffled stream is the same
+        record on every run with that seed. Costs one full decode of the
+        split into host memory (fine at the reference's 25k-sequence scale;
+        leave unset to stream — the reference shuffles at ETL time only,
+        generate_data.py)."""
         if batch_size % process_count:
             raise ValueError(
                 f"global batch {batch_size} not divisible by "
                 f"{process_count} processes"
+            )
+        if shuffle_seed is not None and shuffle_seed < 0:
+            # numpy's SeedSequence rejects negatives with a traceback that
+            # never names the flag — fail at the API boundary instead
+            raise ValueError(
+                f"shuffle_seed must be a non-negative int, got {shuffle_seed}"
             )
         local_bs = batch_size // process_count
 
@@ -157,21 +173,40 @@ def iterator_from_tfrecords_folder(
             # (counts come from the filename contract).
             gidx = (skip // num_seqs) * num_seqs if (loop and num_seqs) else 0
             buf: List[bytes] = []
+            shuffled: List[bytes] | None = None
+            if shuffle_seed is not None:
+                shuffled = [
+                    r for path in filenames for r in read_tfrecords(path)
+                ]
+
+            def pass_records(pass_index: int) -> Iterator[bytes]:
+                if shuffled is None:
+                    for path, cnt in zip(filenames, file_counts):
+                        if gidx_box[0] + cnt <= skip:
+                            # whole file before the skip: no read
+                            gidx_box[0] += cnt
+                            continue
+                        yield from read_tfrecords(path)
+                    return
+                order = np.random.default_rng(
+                    (shuffle_seed, pass_index)
+                ).permutation(len(shuffled))
+                for i in order:
+                    yield shuffled[i]
+
+            gidx_box = [gidx]
             while True:
-                for path, cnt in zip(filenames, file_counts):
-                    if gidx + cnt <= skip:
-                        gidx += cnt  # whole file before the skip: no read
+                for rec in pass_records(gidx_box[0] // max(num_seqs, 1)):
+                    idx = gidx_box[0]
+                    gidx_box[0] = idx + 1
+                    if idx < skip:
                         continue
-                    for rec in read_tfrecords(path):
-                        idx, gidx = gidx, gidx + 1
-                        if idx < skip:
-                            continue
-                        if idx % process_count != process_index:
-                            continue
-                        buf.append(rec)
-                        if len(buf) == local_bs:
-                            yield collate(buf, seq_len)
-                            buf = []
+                    if idx % process_count != process_index:
+                        continue
+                    buf.append(rec)
+                    if len(buf) == local_bs:
+                        yield collate(buf, seq_len)
+                        buf = []
                 if not loop:
                     if buf:  # ragged tail (the reference yields it too)
                         yield collate(buf, seq_len)
