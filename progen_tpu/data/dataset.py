@@ -82,27 +82,61 @@ def collate(
     return out
 
 
-def _prefetch(gen: Iterator, depth: int) -> Iterator:
-    """Run ``gen`` in a daemon thread, buffering up to ``depth`` items."""
-    q: queue.Queue = queue.Queue(maxsize=depth)
+def _prefetch(
+    gen: Iterator, depth: int, stop: "threading.Event | None" = None
+) -> Iterator:
+    """Run ``gen`` in a daemon thread, buffering up to ``depth`` items.
+
+    The worker has a real lifecycle: closing (or garbage-collecting) the
+    returned iterator sets ``stop``, which the worker observes before
+    advancing the source generator and when unblocked from a full queue
+    (the consumer drains one slot after setting stop, so the steady-state
+    put stays a cheap blocking wait, not a poll). Pass the same ``stop``
+    event into the source generator to also interrupt long per-item work.
+    Without this, every abandoned ``loop=True`` iterator (e.g. a
+    validation stream recreated per eval) leaks a thread that keeps
+    reading shards forever — a real resource leak in a long trainer, and
+    the cross-test race that intermittently failed the resume suite under
+    machine load."""
+    q: queue.Queue = queue.Queue(maxsize=max(depth, 1))
     _END = object()
+    if stop is None:
+        stop = threading.Event()
 
     def worker():
         try:
-            for item in gen:
+            while not stop.is_set():
+                try:
+                    item = next(gen)
+                except StopIteration:
+                    q.put(_END)
+                    return
                 q.put(item)
-            q.put(_END)
         except BaseException as e:  # propagate into the consumer
-            q.put(e)
+            if not stop.is_set():
+                q.put(e)
+        finally:
+            gen.close()
 
-    threading.Thread(target=worker, daemon=True).start()
-    while True:
-        item = q.get()
-        if item is _END:
-            return
-        if isinstance(item, BaseException):
-            raise item
-        yield item
+    thread = threading.Thread(
+        target=worker, daemon=True, name="progen-prefetch"
+    )
+    thread.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+        try:  # unblock a worker waiting on a full queue
+            q.get_nowait()
+        except queue.Empty:
+            pass
+        thread.join(timeout=1.0)
 
 
 def iterator_from_tfrecords_folder(
@@ -153,6 +187,7 @@ def iterator_from_tfrecords_folder(
                 f"shuffle_seed must be a non-negative int, got {shuffle_seed}"
             )
         local_bs = batch_size // process_count
+        stop = threading.Event()  # set when the returned iterator closes
 
         def batches() -> Iterator[np.ndarray]:
             # The record index is GLOBAL across passes, so ``skip`` resumes
@@ -175,13 +210,17 @@ def iterator_from_tfrecords_folder(
             buf: List[bytes] = []
             shuffled: List[bytes] | None = None
             if shuffle_seed is not None:
-                shuffled = [
-                    r for path in filenames for r in read_tfrecords(path)
-                ]
+                shuffled = []
+                for path in filenames:
+                    if stop.is_set():  # interrupt the full-split decode
+                        return
+                    shuffled.extend(read_tfrecords(path))
 
             def pass_records(pass_index: int) -> Iterator[bytes]:
                 if shuffled is None:
                     for path, cnt in zip(filenames, file_counts):
+                        if stop.is_set():
+                            return
                         if gidx_box[0] + cnt <= skip:
                             # whole file before the skip: no read
                             gidx_box[0] += cnt
@@ -195,7 +234,7 @@ def iterator_from_tfrecords_folder(
                     yield shuffled[i]
 
             gidx_box = [gidx]
-            while True:
+            while not stop.is_set():
                 for rec in pass_records(gidx_box[0] // max(num_seqs, 1)):
                     idx = gidx_box[0]
                     gidx_box[0] = idx + 1
@@ -212,6 +251,6 @@ def iterator_from_tfrecords_folder(
                         yield collate(buf, seq_len)
                     return
 
-        return _prefetch(batches(), prefetch)
+        return _prefetch(batches(), prefetch, stop=stop)
 
     return num_seqs, iter_fn

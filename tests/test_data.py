@@ -308,6 +308,32 @@ class TestResumeContracts:
         expect = [s.decode() for s in seqs]
         assert rows == (expect * 3)[:25]
 
+    def test_prefetch_worker_stops_on_close(self, tmp_path):
+        """Closing (or dropping) an iterator must stop its prefetch thread:
+        abandoned loop=True streams otherwise leak a reader thread per
+        validation pass, and a stale worker's reads race later iterators."""
+        import threading
+        import time
+
+        def workers():
+            return {
+                t for t in threading.enumerate()
+                if t.name == "progen-prefetch" and t.is_alive()
+            }
+
+        _write_shards(tmp_path)
+        _, iter_fn = iterator_from_tfrecords_folder(str(tmp_path))
+        before = workers()
+        it = iter_fn(seq_len=16, batch_size=4, loop=True)
+        next(it)
+        mine = workers() - before
+        assert len(mine) == 1  # worker alive
+        it.close()
+        deadline = time.time() + 5.0
+        while (workers() & mine) and time.time() < deadline:
+            time.sleep(0.02)
+        assert not (workers() & mine)  # worker exited
+
     def test_resume_fast_forward_skips_file_reads(self, tmp_path, monkeypatch):
         """Whole files below the skip point (and all completed passes) are
         fast-forwarded from the filename counts without decoding."""
@@ -325,9 +351,13 @@ class TestResumeContracts:
         # of pass 2 are read
         it = iter_fn(seq_len=16, batch_size=4, skip=29, loop=True)
         first = next(it)
+        it.close()  # stop the prefetch worker before the monkeypatch lifts
         assert decode_tokens(first[0]) == seqs[5].decode()
-        assert len(opened) >= 1
-        assert all("0.4.train" not in p for p in opened[:1])
+        # scope to THIS test's shards: a stale prefetch worker from another
+        # (closed) iterator must not pollute the file-read record
+        mine = [p for p in opened if str(tmp_path) in p]
+        assert len(mine) >= 1
+        assert all("0.4.train" not in p for p in mine[:1])
 
     def test_shuffle_deterministic_and_per_epoch(self, tmp_path):
         """shuffle_seed: same seed -> identical stream across iterators
