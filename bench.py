@@ -61,7 +61,44 @@ import numpy as np
 _REPO = Path(__file__).resolve().parent
 _DETAIL_PATH = _REPO / "BENCH_DETAIL.json"
 
-# (name, timeout_sec) in execution order; budget cuts from the tail
+
+def _value_fence(out) -> None:
+    """Force every leaf of ``out`` to finish executing by READING a value
+    back to the host. ``jax.block_until_ready`` is not a reliable fence
+    over the axon relay — in round 3 it returned after dispatch-ack,
+    timing dispatch rate instead of compute (implied device FLOP/s ~9x a
+    v5e's physical peak). A host read cannot complete before the device
+    work it depends on, whatever the transport. Scalars are fetched
+    directly; arrays are reduced on device first so only 4 bytes move."""
+    import jax
+    import jax.numpy as jnp
+
+    total = None
+    for leaf in jax.tree_util.tree_leaves(out):
+        s = (
+            leaf.astype(jnp.float32)
+            if getattr(leaf, "ndim", 0) == 0
+            else jnp.sum(leaf.astype(jnp.float32))
+        )
+        total = s if total is None else total + s
+    if total is not None:
+        float(total)  # ONE host round-trip for the whole tree
+
+
+def _suspect_fields(flops: float, seconds: float, peak: float) -> dict:
+    """Honesty-guard fields for ANY timed phase: implied device FLOP/s and
+    a flag when it exceeds physical peak — a number past peak means the
+    measurement (not the chip) is broken and must not be read as real."""
+    implied = flops / max(seconds, 1e-12)
+    return {
+        "implied_device_tflops": round(implied / 1e12, 1),
+        "timing_suspect": bool(implied > 1.1 * peak),
+    }
+
+# (name, timeout_sec) in execution order; budget cuts from the tail.
+# decode-tiny runs LAST: in round 3 it wedged the relay when its subprocess
+# was killed at timeout, which took down every later phase — nothing may
+# run after it that we are not willing to lose.
 _PHASES = (
     ("train-tiny", 720),
     ("kernel-w256", 420),
@@ -69,10 +106,10 @@ _PHASES = (
     ("train-tiny-pallas", 720),
     ("train-long8k", 1080),
     ("train-long8k-xla", 1080),
-    ("decode-tiny", 600),
     ("train-default", 600),
     ("train-base", 720),
-    ("sgu-mix", 420),  # last: micro-bench, lowest priority under budget
+    ("sgu-mix", 420),
+    ("decode-tiny", 600),
 )
 
 # per-config bench recipes: (grad_accum, micro_batch, iters)
@@ -220,22 +257,23 @@ def _train_bench(config_name: str, *, use_pallas=None) -> dict:
         device_batch = put_batch(batch, mesh, accum_axis=True)
         t0 = time.perf_counter()
         state, metrics = step(state, device_batch)  # warmup/compile
-        jax.block_until_ready(metrics["loss"])
+        # _value_fence rationale: the loss read cannot complete before the
+        # step has run (and, via the donated state chain, every step
+        # before it)
+        _value_fence(metrics["loss"])
         compile_s = time.perf_counter() - t0
 
         t0 = time.perf_counter()
         for _ in range(n_iters):
             state, metrics = step(state, device_batch)
-        jax.block_until_ready(metrics["loss"])
+        loss_val = float(metrics["loss"])
         dt = time.perf_counter() - t0
 
     tokens_per_step = grad_accum * micro_bs * config.seq_len
     per_chip = tokens_per_step * n_iters / dt / n_chips
-    mfu = (
-        per_chip
-        * profiling.flops_per_token(config)
-        / profiling.peak_flops(jax.devices()[0])
-    )
+    peak = profiling.peak_flops(jax.devices()[0])
+    per_chip_flops = per_chip * profiling.flops_per_token(config)
+    mfu = per_chip_flops / peak
     return {
         "phase": f"train-{config_name}"
         + ("-pallas" if use_pallas else "-xla" if use_pallas is False else ""),
@@ -248,8 +286,9 @@ def _train_bench(config_name: str, *, use_pallas=None) -> dict:
         "batch": f"{grad_accum}x{micro_bs}x{config.seq_len}",
         "dtype": config.dtype,
         "use_pallas_attn": config.use_pallas_attn,
-        "loss": round(float(metrics["loss"]), 4),
+        "loss": round(loss_val, 4),
         "chips": n_chips,
+        **_suspect_fields(per_chip_flops, 1.0, peak),  # per_chip_flops is /s
         "platform": jax.devices()[0].platform,
     }
 
@@ -280,11 +319,12 @@ def _kernel_bench(window: int) -> dict:
     q, k, v = (jax.random.normal(kk, (b, h, n, d), jnp.bfloat16) for kk in ks)
 
     def time_fn(fn, iters):
-        out = jax.block_until_ready(fn(q, k, v))  # compile
+        out = fn(q, k, v)  # compile
+        _value_fence(out)
         t0 = time.perf_counter()
         for _ in range(iters):
             out = fn(q, k, v)
-        jax.block_until_ready(out)
+        _value_fence(out)  # in-order device stream: all iters must finish
         return (time.perf_counter() - t0) / iters, out
 
     xla_fwd = jax.jit(lambda q, k, v: local_attention(q, k, v, window_size=w))
@@ -325,6 +365,14 @@ def _kernel_bench(window: int) -> dict:
             for a, b_ in zip(g_x, g_p)
         )
     best = min(t_pb, key=t_pb.get)
+    from progen_tpu import profiling as _prof
+
+    peak = _prof.peak_flops(jax.devices()[0])
+    # score + value einsums, 2 FLOP/MAC, ctx = 2w per query
+    fwd_flops = 2 * 2 * b * h * n * (2 * w) * d
+    bwd_flops = 2 * fwd_flops  # dq,dk,dv reuse both einsums (lower bound)
+    fwd_guard = _suspect_fields(fwd_flops, min(t_xf, t_pf), peak)
+    bwd_guard = _suspect_fields(bwd_flops, min(t_xb, *t_pb.values()), peak)
     return {
         "phase": f"kernel-w{window}",
         "fwd_ms": {"xla": round(t_xf * 1e3, 3), "pallas": round(t_pf * 1e3, 3)},
@@ -340,6 +388,12 @@ def _kernel_bench(window: int) -> dict:
         "bwd_max_abs_err": bwd_err,  # per impl: a regression in the
                                      # slower one must stay visible
         "shape": f"b{b} h{h} n{n} d{d} w{w} bf16",
+        "timing_suspect": fwd_guard["timing_suspect"]
+        or bwd_guard["timing_suspect"],
+        "implied_device_tflops": {
+            "fwd_fastest": fwd_guard["implied_device_tflops"],
+            "bwd_fastest": bwd_guard["implied_device_tflops"],
+        },
         "mosaic_compiled": on_tpu,
         "platform": jax.devices()[0].platform,
     }
@@ -376,17 +430,26 @@ def _sgu_mix_bench() -> dict:
             fn = jax.jit(
                 lambda g, w: causal_sgu_mix(g, w, bias, block_size)
             )
-        out = jax.block_until_ready(fn(gate, w))
+        _value_fence(fn(gate, w))  # compile
         t0 = time.perf_counter()
         for _ in range(iters):
             out = fn(gate, w)
-        jax.block_until_ready(out)
+        _value_fence(out)
         return (time.perf_counter() - t0) / iters
 
     t_dense_f, t_block_f = timed(0, False), timed(block, False)
     t_dense_b, t_block_b = timed(0, True), timed(block, True)
+    from progen_tpu import profiling as _prof
+
+    peak = _prof.peak_flops(jax.devices()[0])
+    dense_fwd_flops = 2 * b * n * n * d_half  # (n,n) mix, 2 FLOP/MAC
+    guard = _suspect_fields(
+        dense_fwd_flops, min(t_dense_f, t_block_f / 0.6), peak
+    )  # blocked does ~0.6x dense MACs at these shapes
     return {
         "phase": "sgu-mix",
+        "timing_suspect": guard["timing_suspect"],
+        "implied_device_tflops": guard["implied_device_tflops"],
         "shape": f"b{b} n{n} d{d_half} block{block}",
         "fwd_ms": {
             "dense": round(t_dense_f * 1e3, 3),
@@ -416,7 +479,15 @@ def _decode_bench() -> dict:
     from progen_tpu.sampling import sample, sample_fast, sample_fast_batched
 
     on_tpu = _is_tpu_platform(jax.devices()[0].platform)
-    config = _load_config("tiny" if on_tpu else "smoke")
+    # half-context tiny on TPU: three separate decoder jits compile in this
+    # phase, and in round 3 the full-length naive decode blew the phase
+    # window and wedged the relay on kill. The SGU binds the forward to
+    # seq_len, so the model itself is built at the shorter length.
+    config = (
+        _load_config("tiny", seq_len=512)
+        if on_tpu
+        else _load_config("smoke")
+    )
     model = ProGen(config)
     tokens = jnp.zeros((1, config.seq_len), jnp.int32)
     params = nn.meta.unbox(
@@ -428,14 +499,12 @@ def _decode_bench() -> dict:
 
     def run(fn):
         t0 = time.perf_counter()
-        out = jax.block_until_ready(
-            fn(key, model, params, prime, length, 25, True)
-        )
+        out = fn(key, model, params, prime, length, 25, True)
+        _value_fence(out)
         compile_s = time.perf_counter() - t0
         t0 = time.perf_counter()
-        out = jax.block_until_ready(
-            fn(jax.random.PRNGKey(8), model, params, prime, length, 25, True)
-        )
+        out = fn(jax.random.PRNGKey(8), model, params, prime, length, 25, True)
+        _value_fence(out)
         dt = time.perf_counter() - t0
         gen = length - int(prime.shape[0]) - 1
         return gen / dt, compile_s, out
@@ -453,9 +522,22 @@ def _decode_bench() -> dict:
         )
     )
     batched_tps *= bsz
+    from progen_tpu import profiling as _prof
+
+    peak = _prof.peak_flops(jax.devices()[0])
+    # fwd-only flops/token = (6N convention)/3; the naive path pays a full
+    # length-n forward per generated token
+    fwd_tok = _prof.flops_per_token(config) / 3
+    guard = _suspect_fields(
+        max(batched_tps * fwd_tok, naive_tps * length * fwd_tok),
+        1.0,
+        peak,
+    )
     return {
         "phase": "decode-tiny",
-        "config": "tiny" if on_tpu else "smoke",
+        "timing_suspect": guard["timing_suspect"],
+        "implied_device_tflops": guard["implied_device_tflops"],
+        "config": "tiny-seq512" if on_tpu else "smoke",
         "kv_cache_tokens_per_sec": round(fast_tps, 1),
         "kv_batched8_tokens_per_sec": round(batched_tps, 1),
         "naive_tokens_per_sec": round(naive_tps, 1),
@@ -553,11 +635,34 @@ def run_phase(name: str) -> dict:
 # --------------------------------------------------------------------------
 
 
-def _write_detail(detail: dict) -> None:
+def _write_detail(detail: dict, path: Path | None = None) -> None:
     try:
-        _DETAIL_PATH.write_text(json.dumps(detail, indent=1))
+        (path or _DETAIL_PATH).write_text(json.dumps(detail, indent=1))
     except OSError as e:  # never let bookkeeping kill the bench
         print(f"[bench] detail write failed: {e}", file=sys.stderr)
+
+
+def _has_tpu_evidence(detail: dict) -> bool:
+    return detail.get("platform") == "tpu" and any(
+        "error" not in p for p in detail.get("phases", [])
+    )
+
+
+def _write_detail_guarded(detail: dict) -> None:
+    """Detail write that can never replace successful TPU evidence with a
+    record holding none (CPU fallback, or a run where the relay died
+    before any phase landed — round 3 hit both). Evidence-free records
+    divert to BENCH_DETAIL_FALLBACK.json."""
+    try:
+        prior = json.loads(_DETAIL_PATH.read_text())
+    except (OSError, json.JSONDecodeError):
+        prior = None
+    if prior and _has_tpu_evidence(prior) and not _has_tpu_evidence(detail):
+        _write_detail(
+            detail, path=_DETAIL_PATH.with_name("BENCH_DETAIL_FALLBACK.json")
+        )
+    else:
+        _write_detail(detail)
 
 
 def _run_phase_subprocess(name: str, timeout: float):
@@ -614,7 +719,7 @@ def main() -> None:
         result = _cpu_smoke()
         detail["phases"].append(result)
         detail["phases"].append(_large_projection())
-        _write_detail(detail)
+        _write_detail_guarded(detail)
         print(json.dumps(result), flush=True)
         return
 
@@ -638,7 +743,7 @@ def main() -> None:
                 "error": f"phase ran on {res.get('platform')}, not tpu",
             }
         detail["phases"].append(res)
-        _write_detail(detail)
+        _write_detail_guarded(detail)
         print(f"[bench] {name}: {json.dumps(res)[:300]}", file=sys.stderr)
 
         if name == "train-tiny" and "error" not in res:
@@ -654,6 +759,8 @@ def main() -> None:
                 "step_ms": res["step_ms"],
                 "config": "progen-tiny (dim=512 depth=12 seq=1024 w=256) "
                           "bf16",
+                "implied_device_tflops": res.get("implied_device_tflops"),
+                "timing_suspect": res.get("timing_suspect", False),
                 "platform": "tpu",
             }
             # print + flush NOW: if a later phase wedges the relay and the
@@ -661,11 +768,11 @@ def main() -> None:
             print(json.dumps(headline), flush=True)
         if "error" in res and not _tpu_probe_ok(120):
             detail["relay_died_after"] = name
-            _write_detail(detail)
+            _write_detail_guarded(detail)
             break
 
     detail["phases"].append(_large_projection())
-    _write_detail(detail)
+    _write_detail_guarded(detail)
 
     if headline is None:
         # tiny phase failed: fall back to an honest CPU smoke so the driver
@@ -673,7 +780,7 @@ def main() -> None:
         _force_cpu()
         result = _cpu_smoke()
         detail["phases"].append(result)
-        _write_detail(detail)
+        _write_detail_guarded(detail)
         print(json.dumps(result), flush=True)
         return
 

@@ -141,3 +141,54 @@ class TestOrchestrator:
         detail = json.loads((tmp_path / "BENCH_DETAIL.json").read_text())
         kern = [p for p in detail["phases"] if p["phase"] == "kernel-w256"]
         assert "error" in kern[0]  # CPU fallback never masquerades as TPU
+
+
+class TestDetailGuard:
+    """_write_detail_guarded: an evidence-free record (CPU fallback, or a
+    run where the relay died before any phase landed) must never replace a
+    BENCH_DETAIL.json holding successful TPU evidence."""
+
+    def _with_detail_path(self, bench, monkeypatch, tmp_path):
+        monkeypatch.setattr(bench, "_DETAIL_PATH",
+                            tmp_path / "BENCH_DETAIL.json")
+
+    def test_junk_diverts_when_tpu_evidence_exists(self, bench, monkeypatch,
+                                                   tmp_path):
+        import json
+
+        self._with_detail_path(bench, monkeypatch, tmp_path)
+        good = {"platform": "tpu",
+                "phases": [{"phase": "train-tiny", "mfu": 0.4}]}
+        bench._write_detail(good)
+        junk = {"platform": "tpu",
+                "phases": [{"phase": "train-tiny", "error": "relay died"}]}
+        bench._write_detail_guarded(junk)
+        kept = json.loads((tmp_path / "BENCH_DETAIL.json").read_text())
+        assert kept == good  # evidence preserved
+        diverted = json.loads(
+            (tmp_path / "BENCH_DETAIL_FALLBACK.json").read_text()
+        )
+        assert diverted == junk  # attempt still recorded, elsewhere
+
+    def test_fresh_evidence_overwrites(self, bench, monkeypatch, tmp_path):
+        import json
+
+        self._with_detail_path(bench, monkeypatch, tmp_path)
+        old = {"platform": "tpu",
+               "phases": [{"phase": "train-tiny", "mfu": 0.1}]}
+        bench._write_detail(old)
+        new = {"platform": "tpu",
+               "phases": [{"phase": "train-tiny", "mfu": 0.2}]}
+        bench._write_detail_guarded(new)
+        kept = json.loads((tmp_path / "BENCH_DETAIL.json").read_text())
+        assert kept == new  # fresh TPU evidence replaces old
+
+    def test_no_prior_file_writes_in_place(self, bench, monkeypatch,
+                                           tmp_path):
+        import json
+
+        self._with_detail_path(bench, monkeypatch, tmp_path)
+        smoke = {"platform": "cpu-fallback", "phases": [{"metric": "x"}]}
+        bench._write_detail_guarded(smoke)
+        kept = json.loads((tmp_path / "BENCH_DETAIL.json").read_text())
+        assert kept == smoke
