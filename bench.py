@@ -643,8 +643,13 @@ def _write_detail(detail: dict, path: Path | None = None) -> None:
 
 
 def _has_tpu_evidence(detail: dict) -> bool:
+    """True only for ON-CHIP phase results: the closed-form
+    large-projection study and metric-only smoke entries run without a
+    chip, so they never count as evidence."""
     return detail.get("platform") == "tpu" and any(
-        "error" not in p for p in detail.get("phases", [])
+        "error" not in p
+        and p.get("phase") not in (None, "large-projection")
+        for p in detail.get("phases", [])
     )
 
 

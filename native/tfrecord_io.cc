@@ -285,4 +285,24 @@ long tfio_encode_record(const uint8_t* seq, long seq_len, const char* key,
   return p - out;
 }
 
+// Batch collation: raw sequence bytes -> (n, seq_len+1) int32 rows, the
+// hot per-batch loop of the training input pipeline (truncate to seq_len,
+// +offset each byte, right-pad 0, and a 0-valued BOS column at position 0
+// — progen_tpu/data/dataset.py collate(), mirroring the reference's
+// tf.data map at /root/reference/progen_transformer/data.py:30-35,67-69).
+// recs: per-record base pointers; lengths: per-record byte counts.
+void tfio_collate(const uint8_t** recs, const long* lengths, long n,
+                  long seq_len, long offset, int32_t* out) {
+  const long row_len = seq_len + 1;
+  for (long i = 0; i < n; ++i) {
+    int32_t* row = out + i * row_len;
+    long m = lengths[i] < seq_len ? lengths[i] : seq_len;
+    row[0] = 0;  // BOS
+    const uint8_t* src = recs[i];
+    for (long j = 0; j < m; ++j)
+      row[j + 1] = static_cast<int32_t>(src[j]) + static_cast<int32_t>(offset);
+    std::memset(row + 1 + m, 0, sizeof(int32_t) * (seq_len - m));
+  }
+}
+
 }  // extern "C"

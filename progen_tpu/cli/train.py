@@ -283,13 +283,9 @@ def main(
     valid_ds = valid_iter_fn(
         config.seq_len, batch_size, loop=True, **proc_kwargs
     )
-
     if is_coordinator():
         print(f"params: {num_params:,}")
         print(f"train sequences: {num_train:,}  valid: {num_valid:,}")
-
-    train_step = compile_train_step(model, optimizer, state, shardings, mesh)
-    eval_step = compile_eval_step(model, shardings, mesh)
 
     effective_batch = batch_size * grad_accum_every
     sample_rng = jax.random.PRNGKey(seed + 1)
@@ -346,6 +342,12 @@ def main(
     start_step = int(jax.device_get(state.step))
     try:
       with mesh:
+        # compiled steps live INSIDE the try: a jit failure here must
+        # still run the finally that stops the loop=True prefetch workers
+        train_step = compile_train_step(
+            model, optimizer, state, shardings, mesh
+        )
+        eval_step = compile_eval_step(model, shardings, mesh)
         # pre-fetch only when the loop will actually run: resuming a
         # completed run (empty seq_indices) must fall through, not block
         # on a skip-exhausted iterator
@@ -449,15 +451,28 @@ def main(
                 )
 
     finally:
-        if profiler_active:
-            from jax import profiler as jax_profiler
+        # nested so each cleanup runs even if an earlier one raises
+        try:
+            if profiler_active:
+                from jax import profiler as jax_profiler
 
-            jax_profiler.stop_trace()
-        # async mode: publish any committed-but-unfinalized checkpoint and
-        # stop the background thread even on aborts (e.g. the non-finite-
-        # loss raise) — every periodic save's state was verified finite
-        # before it was saved, so the pending snapshot is always good
-        save_ckpt.close()
+                jax_profiler.stop_trace()
+        finally:
+            try:
+                # async mode: publish any committed-but-unfinalized
+                # checkpoint and stop the background thread even on aborts
+                # (e.g. the non-finite-loss raise) — every periodic save's
+                # state was verified finite before it was saved, so the
+                # pending snapshot is always good
+                save_ckpt.close()
+            finally:
+                # stop the prefetch workers (loop=True streams never
+                # exhaust); nested again so one close failing cannot
+                # leak the other worker
+                try:
+                    train_ds.close()
+                finally:
+                    valid_ds.close()
 
     # final checkpoint so short runs (e.g. --num_steps) always persist;
     # next_seq_index counts exactly the records consumed by executed steps

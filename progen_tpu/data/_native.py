@@ -84,6 +84,15 @@ def load() -> Optional[ctypes.CDLL]:
         ctypes.c_char_p,
         ctypes.c_long,
     ]
+    lib.tfio_collate.restype = None
+    lib.tfio_collate.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p),  # per-record base pointers
+        ctypes.POINTER(ctypes.c_long),
+        ctypes.c_long,
+        ctypes.c_long,
+        ctypes.c_long,
+        ctypes.c_void_p,  # int32 (n, seq_len+1) output buffer
+    ]
     _lib = lib
     return _lib
 
@@ -140,3 +149,24 @@ def encode_record(seq: bytes, key: bytes = b"seq") -> Optional[bytes]:
     if written < 0:
         raise RuntimeError("native encode buffer undersized (bug)")
     return buf.raw[:written]
+
+
+def collate(records, seq_len: int, offset: int = 1):
+    """Batch collation in C++: list of raw sequence bytes -> (n, seq_len+1)
+    int32 (truncate, +offset, right-pad 0, BOS column — the semantics of
+    dataset.collate). Returns None if the library is unavailable."""
+    import numpy as np
+
+    lib = load()
+    if lib is None:
+        return None
+    n = len(records)
+    out = np.empty((n, seq_len + 1), dtype=np.int32)
+    if n:
+        ptrs = (ctypes.c_char_p * n)(*records)
+        lens = (ctypes.c_long * n)(*(len(r) for r in records))
+        lib.tfio_collate(
+            ptrs, lens, n, seq_len, offset,
+            out.ctypes.data_as(ctypes.c_void_p),
+        )
+    return out

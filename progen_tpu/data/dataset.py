@@ -32,6 +32,7 @@ from typing import Callable, Iterator, List, Tuple
 
 import numpy as np
 
+from progen_tpu.data import _native
 from progen_tpu.data.tfrecord import read_tfrecords
 
 _FILENAME_RE = re.compile(r"(\d+)\.(\d+)\.(train|valid)\.tfrecord\.gz$")
@@ -74,7 +75,15 @@ def collate(
     records: List[bytes], seq_len: int, offset: int = 1
 ) -> np.ndarray:
     """Raw sequence bytes -> (batch, seq_len+1) int32: truncate, +offset,
-    right-pad 0, prepend BOS 0 column (data.py:30-35,67-69)."""
+    right-pad 0, prepend BOS 0 column (data.py:30-35,67-69).
+
+    Dispatches to the native C++ engine when available (one pass, no
+    per-record numpy temporaries — this is the per-batch hot loop of the
+    input pipeline); the numpy path below is the fallback and the golden
+    for the native one (tests/test_native.py)."""
+    native_out = _native.collate(records, seq_len, offset)
+    if native_out is not None:
+        return native_out
     out = np.zeros((len(records), seq_len + 1), dtype=np.int32)
     for i, rec in enumerate(records):
         arr = np.frombuffer(rec, dtype=np.uint8)[:seq_len].astype(np.int32)

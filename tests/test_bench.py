@@ -161,7 +161,12 @@ class TestDetailGuard:
                 "phases": [{"phase": "train-tiny", "mfu": 0.4}]}
         bench._write_detail(good)
         junk = {"platform": "tpu",
-                "phases": [{"phase": "train-tiny", "error": "relay died"}]}
+                "phases": [
+                    {"phase": "train-tiny", "error": "relay died"},
+                    # main() always appends this chip-free study; it must
+                    # NOT count as on-chip evidence
+                    {"phase": "large-projection", "num_params": 1},
+                ]}
         bench._write_detail_guarded(junk)
         kept = json.loads((tmp_path / "BENCH_DETAIL.json").read_text())
         assert kept == good  # evidence preserved
