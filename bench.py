@@ -570,8 +570,14 @@ def _large_projection() -> dict:
     fpt = profiling.flops_per_token(cfg)
     peak = 197e12             # v5e bf16
     # v5e-64 mesh plan: model=8 (qkv/mlp/vocab sharded), data=8
-    model_ax = 8
+    model_ax, data_ax = 8, 8
     per_chip_state = (state_bytes + grads_bytes) / model_ax
+    # --zero1: AdamW m+v (8 B/param) shard over data as well
+    per_chip_zero1 = (
+        4 * p / model_ax            # f32 params
+        + 8 * p / (model_ax * data_ax)  # moments
+        + grads_bytes / model_ax
+    )
     target_mfu = 0.45
     projected_tps_chip = target_mfu * peak / fpt
     return {
@@ -582,6 +588,9 @@ def _large_projection() -> dict:
         "hbm_fit_single_chip": False,
         "mesh_plan": {"data": 8, "model": model_ax, "seq": 1},
         "per_chip_state_gb_at_model8": round(per_chip_state / 2**30, 2),
+        "per_chip_state_gb_at_model8_zero1": round(
+            per_chip_zero1 / 2**30, 2
+        ),
         "flops_per_token": fpt,
         "projected_tokens_per_sec_per_chip_at_45pct_mfu": round(
             projected_tps_chip, 1

@@ -85,6 +85,10 @@ def confirm(question: str) -> bool:
                    "are snapshotted to host synchronously; the storage "
                    "commit runs in the background and finalizes at the next "
                    "save)")
+@click.option("--zero1", default=False, is_flag=True,
+              help="ZeRO-1: shard the AdamW moments over the data mesh axis "
+                   "(1/data-size the optimizer memory; forward/backward "
+                   "layout unchanged)")
 def main(
     seed,
     batch_size,
@@ -120,6 +124,7 @@ def main(
     naive_sample,
     ring_attn,
     async_checkpoint,
+    zero1,
 ):
     from progen_tpu.checkpoint import Package, get_checkpoint_fns
     from progen_tpu.config import ProGenConfig, load_toml_config
@@ -146,6 +151,7 @@ def main(
         compile_train_step,
         init_train_state,
         compile_eval_step,
+        train_state_shardings,
     )
 
     if hardware_rng:
@@ -241,16 +247,15 @@ def main(
     if last_meta is None:
         state, shardings = init_train_state(
             model, optimizer, jax.random.PRNGKey(seed), config.seq_len,
-            mesh=mesh,
+            mesh=mesh, zero1=zero1,
         )
     else:
         from progen_tpu.checkpoint import sharded_abstract_state
-        from progen_tpu.parallel.partition import state_shardings
 
         boxed, abstract = abstract_train_state(
             model, optimizer, config.seq_len
         )
-        shardings = state_shardings(boxed, mesh)
+        shardings = train_state_shardings(boxed, mesh, zero1=zero1)
         pkg = get_last(sharded_abstract_state(abstract, shardings))
         state = pkg.state
         start_seq_index = pkg.next_seq_index

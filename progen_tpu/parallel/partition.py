@@ -259,6 +259,54 @@ def param_shardings(
     return state_shardings(abstract_variables, mesh, rules)
 
 
+def zero1_opt_shardings(
+    abstract_opt_state: Any,
+    base_opt_shardings: Any,
+    mesh: Mesh,
+) -> Any:
+    """ZeRO-1: upgrade OPTIMIZER-STATE shardings so param-shaped moments
+    (AdamW m/v) also shard over the ``data`` axis. Params/grads keep their
+    base layout (replicated over ``data``), so the forward/backward is
+    untouched; only the optimizer's elementwise update runs on 1/data-size
+    of each moment, and GSPMD turns the gradient all-reduce + sharded
+    update + param add into the reduce-scatter / all-gather pattern — same
+    collective bandwidth, 1/data-size the moment memory. (Beyond the
+    reference, whose optimizer state is host-resident and whole,
+    /root/reference/train.py:113-121; at 1.2B the f32 m+v are 9.1 GB,
+    the single biggest state tensor group.)
+
+    For each moment leaf the LARGEST dimension that is still unsharded in
+    the base spec and divisible by the data-axis size is sharded over
+    ``data``; leaves with no such dimension keep their base sharding
+    (correct, just not memory-reduced).
+    """
+    from flax.core import meta
+
+    data_size = mesh.shape.get("data", 1)
+    if data_size == 1:
+        return base_opt_shardings
+
+    def upgrade(leaf, sharding):
+        shape = getattr(leaf, "shape", ())
+        if not isinstance(sharding, NamedSharding) or not shape:
+            return sharding
+        spec = list(sharding.spec) + [None] * (len(shape) - len(sharding.spec))
+        free = [
+            i
+            for i, (dim, ax) in enumerate(zip(shape, spec))
+            if ax is None and dim > 0 and dim % data_size == 0
+        ]
+        if not free:
+            return sharding
+        pick = max(free, key=lambda i: shape[i])
+        spec[pick] = "data"
+        return NamedSharding(mesh, PartitionSpec(*spec))
+
+    return jax.tree.map(
+        upgrade, meta.unbox(abstract_opt_state), base_opt_shardings
+    )
+
+
 def batch_sharding(mesh: Mesh, *, accum_axis: bool = False) -> NamedSharding:
     """Sharding for an integer token batch: (mb, L) or (accum, mb, L),
     micro-batch dim over ``data``, sequence replicated (the attention wants

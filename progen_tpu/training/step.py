@@ -132,6 +132,23 @@ def abstract_train_state(model, optimizer, seq_len: int) -> Tuple[Any, Any]:
     return boxed, meta.unbox(boxed)
 
 
+def train_state_shardings(boxed_abstract, mesh, rules=DEFAULT_RULES,
+                          zero1: bool = False):
+    """The ONE place a TrainState's shardings tree is built (cold init and
+    checkpoint resume must agree on the layout): base logical-rule
+    shardings, with the ZeRO-1 moment upgrade applied when asked."""
+    shardings = state_shardings(boxed_abstract, mesh, rules)
+    if zero1:
+        from progen_tpu.parallel.partition import zero1_opt_shardings
+
+        shardings = shardings.replace(
+            opt_state=zero1_opt_shardings(
+                boxed_abstract.opt_state, shardings.opt_state, mesh
+            )
+        )
+    return shardings
+
+
 def init_train_state(
     model,
     optimizer,
@@ -139,12 +156,18 @@ def init_train_state(
     seq_len: int,
     mesh=None,
     rules=DEFAULT_RULES,
+    zero1: bool = False,
 ) -> Tuple[TrainState, Any]:
     """Initialize a TrainState of PLAIN arrays (flax Partitioned boxes are
     stripped — sharding metadata lives in the returned shardings tree, not
     in the state, so optax/orbax/donation see ordinary pytrees). With a
     mesh, every leaf is created directly into its NamedSharding via jit
     out_shardings — the full model never materializes on one host.
+
+    ``zero1`` additionally shards the optimizer moments over the ``data``
+    axis (parallel/partition.zero1_opt_shardings); params keep their base
+    layout, so every compiled step/eval/decode fn is unchanged except for
+    the shardings tree it is given.
 
     Returns (state, shardings); shardings is None without a mesh.
     """
@@ -159,7 +182,7 @@ def init_train_state(
         return jax.jit(init_unboxed)(rng), None
 
     abstract = jax.eval_shape(init_fn, rng)
-    shardings = state_shardings(abstract, mesh, rules)
+    shardings = train_state_shardings(abstract, mesh, rules, zero1=zero1)
     with mesh:
         state = jax.jit(init_unboxed, out_shardings=shardings)(rng)
     return state, shardings
