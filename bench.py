@@ -85,6 +85,21 @@ def _value_fence(out) -> None:
         float(total)  # ONE host round-trip for the whole tree
 
 
+def _hbm_stats() -> dict:
+    """Per-device memory stats where the backend exposes them (TPU does;
+    CPU returns nothing) — peak HBM in use is the per-config memory
+    evidence next to each throughput row."""
+    import jax
+
+    stats = getattr(jax.devices()[0], "memory_stats", lambda: None)() or {}
+    out = {}
+    if "peak_bytes_in_use" in stats:
+        out["peak_hbm_gb"] = round(stats["peak_bytes_in_use"] / 2**30, 2)
+    if "bytes_limit" in stats:
+        out["hbm_limit_gb"] = round(stats["bytes_limit"] / 2**30, 2)
+    return out
+
+
 def _suspect_fields(flops: float, seconds: float, peak: float) -> dict:
     """Honesty-guard fields for ANY timed phase: implied device FLOP/s and
     a flag when it exceeds physical peak — a number past peak means the
@@ -100,7 +115,10 @@ def _suspect_fields(flops: float, seconds: float, peak: float) -> dict:
 # was killed at timeout, which took down every later phase — nothing may
 # run after it that we are not willing to lose.
 _PHASES = (
+    # headline FIRST: nothing may run before it whose timeout-kill could
+    # wedge the relay and cost the round's one number
     ("train-tiny", 720),
+    ("calib-matmul", 300),  # fence calibration: known-FLOPs matmul chain
     ("kernel-w256", 420),
     ("kernel-w512", 420),
     ("train-tiny-pallas", 720),
@@ -289,6 +307,7 @@ def _train_bench(config_name: str, *, use_pallas=None) -> dict:
         "loss": round(loss_val, 4),
         "chips": n_chips,
         **_suspect_fields(per_chip_flops, 1.0, peak),  # per_chip_flops is /s
+        **_hbm_stats(),
         "platform": jax.devices()[0].platform,
     }
 
@@ -465,6 +484,59 @@ def _sgu_mix_bench() -> dict:
     }
 
 
+def _calib_bench() -> dict:
+    """Fence calibration: a chained bf16 matmul with KNOWN FLOPs. Each
+    iteration consumes the previous result, so even a dispatch-ack
+    transport must execute the whole chain before the final value fetch.
+    On a real v5e the 4096-cube matmul should land at a large fraction of
+    the 197 bf16 TFLOP/s peak — and NEVER above it. This is the on-chip
+    proof that the suite's timing methodology measures compute, not
+    dispatch (the round-3 block_until_ready failure mode)."""
+    import jax
+    import jax.numpy as jnp
+
+    from progen_tpu import profiling
+
+    on_tpu = _is_tpu_platform(jax.devices()[0].platform)
+    n = 4096 if on_tpu else 256
+    chain_len, iters = 8, 10
+
+    @jax.jit
+    def chain(x, b):
+        for _ in range(chain_len):
+            x = x @ b
+        return x
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    a = jax.random.normal(k1, (n, n), jnp.bfloat16)
+    # 1/sqrt(n) keeps the chain magnitude-STABLE (variance-preserving):
+    # a 1/n scale underflows bf16 to exact zeros ~21 multiplies in, and a
+    # zero-operand chain is a weaker proof that compute actually ran
+    b = jax.random.normal(k2, (n, n), jnp.bfloat16) / jnp.sqrt(
+        jnp.float32(n)
+    ).astype(jnp.bfloat16)
+    _value_fence(chain(a, b))  # compile
+    t0 = time.perf_counter()
+    x = a
+    for _ in range(iters):
+        x = chain(x, b)
+    _value_fence(x)
+    dt = time.perf_counter() - t0
+
+    flops = iters * chain_len * 2 * n**3
+    peak = profiling.peak_flops(jax.devices()[0])
+    achieved = flops / dt
+    return {
+        "phase": "calib-matmul",
+        "shape": f"{n}x{n} bf16, chain {chain_len} x {iters} iters",
+        "achieved_tflops": round(achieved / 1e12, 1),
+        "peak_tflops": round(peak / 1e12, 1),
+        "mxu_efficiency": round(achieved / peak, 3),
+        "timing_suspect": bool(achieved > 1.1 * peak),
+        "platform": jax.devices()[0].platform,
+    }
+
+
 def _decode_bench() -> dict:
     """Autoregressive decode throughput on the flagship config (BASELINE.md
     config 5): the KV-cache fused decode (sample_fast) vs the
@@ -630,6 +702,8 @@ def run_phase(name: str) -> dict:
         return _train_bench("long8k", use_pallas=False)
     if name.startswith("train-"):
         return _train_bench(name[len("train-"):])
+    if name == "calib-matmul":
+        return _calib_bench()
     if name == "decode-tiny":
         return _decode_bench()
     if name == "sgu-mix":
@@ -817,6 +891,11 @@ def main() -> None:
             summary[ph] = {
                 "kv_tps": res["kv_cache_tokens_per_sec"],
                 "speedup": res["speedup"],
+            }
+        elif ph == "calib-matmul":
+            summary[ph] = {
+                "achieved_tflops": res["achieved_tflops"],
+                "mxu_efficiency": res["mxu_efficiency"],
             }
     print(json.dumps({**headline, "suite": summary}), flush=True)
 
