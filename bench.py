@@ -370,6 +370,30 @@ def _kernel_bench(window: int) -> dict:
     fwd_err = float(
         jnp.abs(o_x.astype(jnp.float32) - o_p.astype(jnp.float32)).max()
     )
+    # forward bh_block variants: g batch-heads per program (fatter blocks,
+    # fewer programs — the small-window perf lever). VMEM caps g at w=512.
+    from progen_tpu.ops.pallas_attention import _safe_bh_block
+
+    fwd_ms_g = {}
+    timed_gs = {1}  # the plain pallas row above is g=1
+    for g_try in (4, 8):
+        g_eff = _safe_bh_block(g_try, b * h, w)  # VMEM cap / divisibility
+        if g_eff in timed_gs:  # e.g. w=512 caps 8 -> 4: don't re-time
+            continue
+        timed_gs.add(g_eff)
+        pl_fwd_g = jax.jit(
+            lambda q, k, v, g_=g_eff: pallas_local_attention(
+                q, k, v, w, None, not on_tpu, "kv", g_
+            )
+        )
+        t_g, o_g = time_fn(pl_fwd_g, iters_f)
+        err_g = float(
+            jnp.abs(o_x.astype(jnp.float32) - o_g.astype(jnp.float32)).max()
+        )
+        fwd_ms_g[f"pallas_g{g_eff}"] = {  # label = EFFECTIVE g
+            "ms": round(t_g * 1e3, 3),
+            "max_err": err_g,
+        }
     t_xb, g_x = time_fn(xla_bwd, iters_b)
     # both pallas backwards: kv (combined-in-register) vs halo (f32
     # scratch + shifted add) — the on-chip winner informs the default
@@ -390,17 +414,23 @@ def _kernel_bench(window: int) -> dict:
     # score + value einsums, 2 FLOP/MAC, ctx = 2w per query
     fwd_flops = 2 * 2 * b * h * n * (2 * w) * d
     bwd_flops = 2 * fwd_flops  # dq,dk,dv reuse both einsums (lower bound)
-    fwd_guard = _suspect_fields(fwd_flops, min(t_xf, t_pf), peak)
+    t_pf_best = min([t_pf] + [v["ms"] / 1e3 for v in fwd_ms_g.values()])
+    fwd_guard = _suspect_fields(fwd_flops, min(t_xf, t_pf_best), peak)
     bwd_guard = _suspect_fields(bwd_flops, min(t_xb, *t_pb.values()), peak)
     return {
         "phase": f"kernel-w{window}",
-        "fwd_ms": {"xla": round(t_xf * 1e3, 3), "pallas": round(t_pf * 1e3, 3)},
+        "fwd_ms": {
+            "xla": round(t_xf * 1e3, 3),
+            "pallas": round(t_pf * 1e3, 3),
+            **{k: v["ms"] for k, v in fwd_ms_g.items()},
+        },
+        "fwd_bh_block_err": {k: v["max_err"] for k, v in fwd_ms_g.items()},
         "bwd_ms": {
             "xla": round(t_xb * 1e3, 3),
             "pallas_kv": round(t_pb["kv"] * 1e3, 3),
             "pallas_halo": round(t_pb["halo"] * 1e3, 3),
         },
-        "fwd_speedup": round(t_xf / t_pf, 2),
+        "fwd_speedup": round(t_xf / t_pf_best, 2),  # best pallas variant
         "bwd_speedup": round(t_xb / t_pb[best], 2),
         "bwd_best_impl": best,
         "fwd_max_abs_err": fwd_err,
@@ -855,9 +885,15 @@ def main() -> None:
             # driver kills us, the headline is already on stdout
             print(json.dumps(headline), flush=True)
         if "error" in res and not _tpu_probe_ok(120):
-            detail["relay_died_after"] = name
-            _write_detail_guarded(detail)
-            break
+            # one cooldown+retry before declaring the relay dead: a probe
+            # right after a killed phase can fail transiently while the
+            # relay tears down that phase's claim
+            time.sleep(60)
+            if not _tpu_probe_ok(120):
+                detail["relay_died_after"] = name
+                _write_detail_guarded(detail)
+                break
+            detail.setdefault("relay_recovered_after", []).append(name)
 
     detail["phases"].append(_large_projection())
     _write_detail_guarded(detail)

@@ -52,6 +52,10 @@ class ProGenConfig:
     param_dtype: str = "float32"
     # Use the Pallas local-attention kernel instead of the XLA reference path.
     use_pallas_attn: bool = False
+    # Batch-heads per Pallas forward program (ops/pallas_attention
+    # bh_block): fatter blocks for small windows; 1 = one window per
+    # program. The kernel bench times variants on-chip — set from evidence.
+    pallas_bh_block: int = 1
     # Use the EXPLICIT ring halo-exchange attention (parallel/ring_attention)
     # instead of letting GSPMD infer the halo collectives. Takes effect only
     # when the model is built with a mesh whose ``seq`` axis is > 1

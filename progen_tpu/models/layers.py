@@ -144,7 +144,9 @@ class LocalAttentionBlock(nn.Module):
             # shipping use_pallas_attn=true (long8k.toml) stays runnable
             # on CPU hosts (tests, smoke runs) without monkeypatching.
             interpret = jax.default_backend() not in ("tpu", "axon")
-            out = pallas_local_attention(q, k, v, w, None, interpret)
+            out = pallas_local_attention(
+                q, k, v, w, None, interpret, "kv", c.pallas_bh_block
+            )
         else:
             out = local_attention(q, k, v, window_size=w)
 

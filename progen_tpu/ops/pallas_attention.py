@@ -60,12 +60,36 @@ def _halo_kv(kp_ref, kc_ref, vp_ref, vc_ref, dtype):
 
 
 def _fwd_kernel(q_ref, kp_ref, kc_ref, vp_ref, vc_ref, o_ref, *, scale):
+    """Forward over a (g, w, d) block: g batch-heads' windows per program
+    (g=1 is the original one-window-per-program layout). Larger g means
+    fewer, fatter programs — bigger MXU tiles and less per-program
+    overhead at small w; bounded by the (g, w, 2w) f32 probabilities in
+    VMEM. The on-chip winner is chosen by the kernel bench, not assumed."""
     w = q_ref.shape[1]
-    q = q_ref[0].astype(jnp.float32)
-    k2, v2 = _halo_kv(kp_ref, kc_ref, vp_ref, vc_ref, jnp.float32)
-    p = _softmax_row(q, k2, w, scale)
-    o = jnp.dot(p, v2, preferred_element_type=jnp.float32)
-    o_ref[0] = o.astype(o_ref.dtype)
+    f32 = jnp.float32
+    q = q_ref[...].astype(f32)  # (g, w, d)
+    not_first = (pl.program_id(1) > 0).astype(f32)
+    k2 = jnp.concatenate(
+        [kp_ref[...].astype(f32) * not_first, kc_ref[...].astype(f32)], axis=1
+    )  # (g, 2w, d)
+    v2 = jnp.concatenate(
+        [vp_ref[...].astype(f32) * not_first, vc_ref[...].astype(f32)], axis=1
+    )
+    s = jax.lax.dot_general(  # (g, w, 2w)
+        q, k2,
+        dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=f32,
+    ) * scale
+    s = jnp.where(_window_mask(w)[None], s, ATTN_MASK_VALUE)
+    s = s - s.max(axis=-1, keepdims=True)
+    e = jnp.exp(s)
+    p = e / e.sum(axis=-1, keepdims=True)
+    o = jax.lax.dot_general(  # (g, w, d)
+        p, v2,
+        dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=f32,
+    )
+    o_ref[...] = o.astype(o_ref.dtype)
 
 
 def _bwd_kernel(
@@ -170,10 +194,12 @@ def _bwd_kv_kernel(
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _index_maps(w: int, d: int):
+def _index_maps(w: int, d: int, g: int = 1):
+    """cur/prev(-clamped) index maps + a BlockSpec factory for (g, w, d)
+    blocks over a (bh, n, d) array; g=1 is one window per program."""
     cur = lambda b, i: (b, i, 0)
     prev = lambda b, i: (b, jnp.maximum(i - 1, 0), 0)
-    block = (1, w, d)
+    block = (g, w, d)
     spec = lambda idx: pl.BlockSpec(block, idx, memory_space=pltpu.VMEM)
     return cur, prev, spec
 
@@ -202,7 +228,7 @@ def _flops(bh: int, n: int, d: int, w: int, n_matmuls: int) -> pl.CostEstimate:
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def pallas_local_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -211,20 +237,33 @@ def pallas_local_attention(
     scale: float | None = None,
     interpret: bool = False,
     bwd_impl: str = "kv",
+    bh_block: int = 1,
 ) -> jnp.ndarray:
     """q, k, v: (batch, heads, n, dim_head), n % window_size == 0.
     Returns (batch, heads, n, dim_head) in q.dtype. ``interpret=True`` runs
     the kernel in the Pallas interpreter (CPU tests). ``bwd_impl``:
     ``"kv"`` (combined-in-register, default) or ``"halo"`` (f32 halo
-    scratch + shifted add) — see the module docstring."""
+    scratch + shifted add) — see the module docstring. ``bh_block``:
+    batch-heads per forward program (falls back to 1 when it doesn't
+    divide batch*heads or its f32 probabilities would exceed ~8 MB VMEM);
+    the kernel bench times variants on-chip."""
     if bwd_impl not in ("kv", "halo"):
         # validate at the call site, not first-grad-time deep in the VJP
         raise ValueError(f"unknown bwd_impl {bwd_impl!r}")
-    out, _ = _fwd(q, k, v, window_size, scale, interpret)
+    out, _ = _fwd(q, k, v, window_size, scale, interpret, bh_block)
     return out
 
 
-def _fwd(q, k, v, window_size, scale, interpret):
+def _safe_bh_block(bh_block: int, bh: int, w: int) -> int:
+    """Largest usable g <= bh_block: must divide bh and keep the (g, w, 2w)
+    f32 probabilities within ~8 MB of VMEM."""
+    g = max(1, min(bh_block, (8 << 20) // (w * 2 * w * 4) or 1))
+    while bh % g:
+        g -= 1
+    return g
+
+
+def _fwd(q, k, v, window_size, scale, interpret, bh_block=1):
     b, h, n, d = q.shape
     w = window_size
     if n % w != 0:
@@ -232,15 +271,15 @@ def _fwd(q, k, v, window_size, scale, interpret):
     if scale is None:
         scale = d ** -0.5
     bh, nw = b * h, n // w
+    g = _safe_bh_block(bh_block, bh, w)
     qf, kf, vf = (t.reshape(bh, n, d) for t in (q, k, v))
 
+    cur, prev, spec = _index_maps(w, d, g)
     out = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale),
-        grid=(bh, nw),
-        in_specs=_specs(w, d),
-        out_specs=pl.BlockSpec(
-            (1, w, d), lambda b_, i: (b_, i, 0), memory_space=pltpu.VMEM
-        ),
+        grid=(bh // g, nw),
+        in_specs=[spec(cur), spec(prev), spec(cur), spec(prev), spec(cur)],
+        out_specs=spec(cur),
         out_shape=jax.ShapeDtypeStruct((bh, n, d), q.dtype),
         cost_estimate=_flops(bh, n, d, w, 2),
         compiler_params=_PARALLEL_GRID,
@@ -249,11 +288,11 @@ def _fwd(q, k, v, window_size, scale, interpret):
     return out.reshape(b, h, n, d), (q, k, v)
 
 
-def _fwd_rule(q, k, v, window_size, scale, interpret, bwd_impl):
-    return _fwd(q, k, v, window_size, scale, interpret)
+def _fwd_rule(q, k, v, window_size, scale, interpret, bwd_impl, bh_block):
+    return _fwd(q, k, v, window_size, scale, interpret, bh_block)
 
 
-def _bwd_rule(window_size, scale, interpret, bwd_impl, residuals, g):
+def _bwd_rule(window_size, scale, interpret, bwd_impl, bh_block, residuals, g):
     q, k, v = residuals
     b, h, n, d = q.shape
     w = window_size

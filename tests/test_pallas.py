@@ -135,3 +135,47 @@ class TestModelIntegration:
         cfg_ref = ProGenConfig(**{**cfg.to_dict(), "use_pallas_attn": False})
         ref = ProGen(cfg_ref).apply({"params": params}, tokens)
         np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+
+
+class TestBhBlock:
+    """bh_block > 1: g batch-heads' windows per forward program — must be
+    numerically identical to g=1 (same math, fatter blocks), with graceful
+    fallback when g doesn't divide bh or would blow the VMEM budget."""
+
+    @pytest.mark.parametrize("g", [2, 3, 6])
+    def test_matches_g1(self, g):
+        q, k, v = _qkv(4)  # bh = 6
+        base = pallas_local_attention(q, k, v, 16, None, True)
+        out = pallas_local_attention(q, k, v, 16, None, True, "kv", g)
+        np.testing.assert_allclose(out, base, atol=1e-6, rtol=1e-6)
+
+    def test_non_dividing_g_falls_back(self):
+        q, k, v = _qkv(5)  # bh = 6; g=4 -> largest divisor <= 4 is 3
+        out = pallas_local_attention(q, k, v, 16, None, True, "kv", 4)
+        ref = local_attention(q, k, v, window_size=16)
+        np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+    def test_vmem_budget_caps_g(self):
+        from progen_tpu.ops.pallas_attention import _safe_bh_block
+
+        # w=512: (g, 512, 1024) f32 probs -> 2 MB per g; 8 MB budget -> 4
+        assert _safe_bh_block(8, 128, 512) == 4
+        # w=256: 0.5 MB per g -> cap 16, bounded by requested 8
+        assert _safe_bh_block(8, 128, 256) == 8
+        # never 0, always divides
+        assert _safe_bh_block(8, 6, 16) == 6
+        assert _safe_bh_block(1, 7, 512) == 1
+
+    def test_gradients_unaffected_by_bh_block(self):
+        # bh_block only changes the forward schedule; the VJP ignores it
+        q, k, v = _qkv(6)
+
+        def loss(fn_g):
+            return lambda q, k, v: fn_g(q, k, v).astype(jnp.float32).sum()
+
+        g1 = jax.grad(loss(lambda q, k, v: pallas_local_attention(
+            q, k, v, 16, None, True, "kv", 1)), argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss(lambda q, k, v: pallas_local_attention(
+            q, k, v, 16, None, True, "kv", 2)), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(a, b, atol=1e-6, rtol=1e-6)

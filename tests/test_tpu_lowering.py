@@ -63,3 +63,19 @@ class TestTpuLowering:
             q, q, q,
         )
         assert "tpu_custom_call" in exp.mlir_module()
+
+    @pytest.mark.parametrize("g", [4, 8])
+    def test_forward_lowers_for_tpu_bh_block(self, g):
+        """The batched (g, w, d) forward blocks must survive the Mosaic
+        MLIR pipeline at bench shapes (bh=16, both windows)."""
+        q = jnp.zeros((2, 8, 1024, 64), jnp.bfloat16)
+        for window in (256, 512):
+            exp = _export_for_tpu(
+                functools.partial(
+                    pallas_local_attention,
+                    window_size=window,
+                    bh_block=g,
+                ),
+                q, q, q,
+            )
+            assert "tpu_custom_call" in exp.mlir_module()
