@@ -60,6 +60,18 @@ import numpy as np
 
 _REPO = Path(__file__).resolve().parent
 _DETAIL_PATH = _REPO / "BENCH_DETAIL.json"
+_LOG_DIR = _REPO / "runs" / "bench_logs"
+
+
+def _mark(msg: str) -> None:
+    """Progress marker on stderr (streamed to the phase log by the
+    orchestrator): when a phase is timeout-killed, the trail shows how far
+    it got — init, compile, or iteration N."""
+    print(f"[bench-mark +{time.perf_counter() - _T0:.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+_T0 = time.perf_counter()
 
 
 def _value_fence(out) -> None:
@@ -111,9 +123,13 @@ def _suspect_fields(flops: float, seconds: float, peak: float) -> dict:
     }
 
 # (name, timeout_sec) in execution order; budget cuts from the tail.
-# decode-tiny runs LAST: in round 3 it wedged the relay when its subprocess
-# was killed at timeout, which took down every later phase — nothing may
-# run after it that we are not willing to lose.
+# Ordering is wedge-risk-driven: both round-3 relay deaths were caused by
+# the timeout-kill of a phase subprocess (decode-tiny in run a,
+# train-tiny-pallas in run b), and everything AFTER the wedge was lost.
+# So: headline first, then the phases that have already proven fast and
+# safe, then all remaining XLA-only phases, and the Pallas-in-train-step
+# phases (slow whole-program Mosaic+XLA compiles, the current kill risk)
+# at the very end alongside decode-tiny.
 _PHASES = (
     # headline FIRST: nothing may run before it whose timeout-kill could
     # wedge the relay and cost the round's one number
@@ -121,12 +137,12 @@ _PHASES = (
     ("calib-matmul", 300),  # fence calibration: known-FLOPs matmul chain
     ("kernel-w256", 420),
     ("kernel-w512", 420),
-    ("train-tiny-pallas", 720),
-    ("train-long8k", 1080),
-    ("train-long8k-xla", 1080),
     ("train-default", 600),
     ("train-base", 720),
+    ("train-long8k-xla", 1080),
     ("sgu-mix", 420),
+    ("train-long8k", 1500),
+    ("train-tiny-pallas", 1500),
     ("decode-tiny", 600),
 )
 
@@ -257,6 +273,7 @@ def _train_bench(config_name: str, *, use_pallas=None) -> dict:
     grad_accum, micro_bs, n_iters = _RECIPES[config_name]
 
     n_chips = len(jax.devices())
+    _mark(f"devices ok: {n_chips} chip(s)")
     micro_bs *= n_chips
     mesh = make_mesh()
     model = ProGen(config)
@@ -264,6 +281,7 @@ def _train_bench(config_name: str, *, use_pallas=None) -> dict:
     state, shardings = init_train_state(
         model, optimizer, jax.random.PRNGKey(0), config.seq_len, mesh=mesh
     )
+    _mark("train state initialized")
     step = compile_train_step(model, optimizer, state, shardings, mesh)
 
     rng = np.random.default_rng(0)
@@ -273,6 +291,7 @@ def _train_bench(config_name: str, *, use_pallas=None) -> dict:
 
     with mesh:
         device_batch = put_batch(batch, mesh, accum_axis=True)
+        _mark("batch on device; compiling train step")
         t0 = time.perf_counter()
         state, metrics = step(state, device_batch)  # warmup/compile
         # _value_fence rationale: the loss read cannot complete before the
@@ -280,12 +299,15 @@ def _train_bench(config_name: str, *, use_pallas=None) -> dict:
         # before it)
         _value_fence(metrics["loss"])
         compile_s = time.perf_counter() - t0
+        _mark(f"compile+first step done in {compile_s:.1f}s; timing "
+              f"{n_iters} iters")
 
         t0 = time.perf_counter()
         for _ in range(n_iters):
             state, metrics = step(state, device_batch)
         loss_val = float(metrics["loss"])
         dt = time.perf_counter() - t0
+        _mark(f"timed loop done in {dt:.1f}s")
 
     tokens_per_step = grad_accum * micro_bs * config.seq_len
     per_chip = tokens_per_step * n_iters / dt / n_chips
@@ -783,45 +805,99 @@ def _write_detail_guarded(detail: dict) -> None:
         _write_detail(detail)
 
 
+def _phase_log_tail(name: str, n: int = 1200) -> str:
+    # seek-based tail: a wedged phase can spew hundreds of MB of libtpu
+    # diagnostics; never load the whole file for 1200 chars
+    try:
+        with open(_LOG_DIR / f"{name}.log", "rb") as f:
+            f.seek(0, os.SEEK_END)
+            f.seek(max(f.tell() - n, 0))
+            return f.read().decode(errors="replace")
+    except OSError:
+        return ""
+
+
 def _run_phase_subprocess(name: str, timeout: float):
     """One phase in its own process (own chip claim, own crash domain).
     SIGTERM then SIGKILL on timeout — kinder to the relay than an instant
-    kill mid-claim."""
-    proc = subprocess.Popen(
-        [sys.executable, str(_REPO / "bench.py"), "_phase", name],
-        stdout=subprocess.PIPE,
-        stderr=subprocess.PIPE,
-        cwd=str(_REPO),
-        text=True,
-        env={**os.environ, "BENCH_REQUIRE_TPU": "1"},
-    )
-    try:
-        out, err = proc.communicate(timeout=timeout)
-    except subprocess.TimeoutExpired:
-        proc.terminate()
+    kill mid-claim. The child's stderr streams to runs/bench_logs/<name>.log
+    so a killed phase leaves its progress-marker trail ([bench-mark] lines
+    from _mark) for post-mortem — round 3's tiny-pallas timeout was
+    undiagnosable without this."""
+    _LOG_DIR.mkdir(parents=True, exist_ok=True)
+    log_path = _LOG_DIR / f"{name}.log"
+    with open(log_path, "w") as log:
+        proc = subprocess.Popen(
+            [sys.executable, str(_REPO / "bench.py"), "_phase", name],
+            stdout=subprocess.PIPE,
+            stderr=log,
+            cwd=str(_REPO),
+            text=True,
+            env={
+                **os.environ,
+                "BENCH_REQUIRE_TPU": "1",
+                # child self-deadline below the parent kill: a SIGALRM
+                # raised at Python level unwinds and releases the chip
+                # claim cleanly, where SIGTERM/SIGKILL mid-claim has
+                # wedged the relay twice (round 3 runs a and b)
+                "BENCH_PHASE_DEADLINE_SEC": str(max(int(timeout) - 30, 60)),
+            },
+        )
         try:
-            proc.wait(timeout=15)
+            out, _ = proc.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
-            proc.kill()
-            proc.wait()
-        return {"phase": name, "error": f"timeout after {timeout:.0f}s"}
+            proc.terminate()
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+            return {
+                "phase": name,
+                "error": f"timeout after {timeout:.0f}s",
+                "log_tail": _phase_log_tail(name),
+            }
     if proc.returncode != 0:
         return {
             "phase": name,
             "error": f"exit {proc.returncode}",
-            "stderr_tail": err[-800:],
+            "log_tail": _phase_log_tail(name),
         }
     for line in reversed(out.strip().splitlines()):
         try:
-            return json.loads(line)
+            res = json.loads(line)
         except json.JSONDecodeError:
             continue
+        if "error" in res and "log_tail" not in res:
+            # e.g. the child's self-deadline record: attach the marker
+            # trail the same as the kill/exit paths do
+            res["log_tail"] = _phase_log_tail(name)
+        return res
     return {"phase": name, "error": "no JSON in phase output"}
+
+
+def _headline_from(res: dict, prior: float | None) -> dict:
+    per_chip = res["tokens_per_sec_per_chip"]
+    return {
+        "metric": "train_tokens_per_sec_per_chip",
+        "value": per_chip,
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(per_chip / prior, 3) if prior else 1.0,
+        "mfu": res["mfu"],
+        "num_params": res["num_params"],
+        "chips": res["chips"],
+        "step_ms": res["step_ms"],
+        "config": "progen-tiny (dim=512 depth=12 seq=1024 w=256) bf16",
+        "implied_device_tflops": res.get("implied_device_tflops"),
+        "timing_suspect": res.get("timing_suspect", False),
+        "platform": "tpu",
+    }
 
 
 def main() -> None:
     budget = float(os.environ.get("BENCH_BUDGET_SEC", "3000"))
     started = time.perf_counter()
+    resume = "--resume" in sys.argv
     # one probe serves liveness + platform (phase children skip re-probing
     # via BENCH_REQUIRE_TPU — a dead relay there surfaces as a timeout)
     on_tpu = _is_tpu_platform(_probe_platform())
@@ -831,6 +907,27 @@ def main() -> None:
         "platform": "tpu" if on_tpu else "cpu-fallback",
         "phases": [],
     }
+    done: set = set()
+    if resume and on_tpu:
+        # rerun only missing/errored phases, keeping prior clean results
+        # (used by the relay-recovery path after a mid-suite wedge)
+        try:
+            prior_detail = json.loads(_DETAIL_PATH.read_text())
+        except (OSError, json.JSONDecodeError):
+            prior_detail = None
+        if prior_detail and _has_tpu_evidence(prior_detail):
+            # a timing_suspect phase (dispatch-rate artifact, round 3a) is
+            # NOT a keepable result: rerun it rather than resume a number
+            # the honest-timing machinery already rejected
+            detail["phases"] = [
+                p for p in prior_detail["phases"]
+                if p.get("phase")  # drops the phase-less _cpu_smoke record
+                and "error" not in p
+                and not p.get("timing_suspect")
+                and _is_tpu_platform(p.get("platform", "tpu"))
+                and p["phase"] != "large-projection"
+            ]
+            done = {p["phase"] for p in detail["phases"]}
 
     if not on_tpu:
         _force_cpu()
@@ -843,7 +940,16 @@ def main() -> None:
 
     headline = None
     prior = _prior_round_value()
+    for p in detail["phases"]:
+        if p.get("phase") == "train-tiny":
+            headline = _headline_from(p, prior)  # resumed prior headline
+            # flush now, same wedge-insurance as the fresh-run path: if
+            # the first rerun phase wedges the relay and we get killed,
+            # the prior clean headline is already on stdout
+            print(json.dumps(headline), flush=True)
     for name, timeout in _PHASES:
+        if name in done:
+            continue
         remaining = budget - (time.perf_counter() - started)
         if remaining < 90:
             detail["phases"].append(
@@ -865,22 +971,7 @@ def main() -> None:
         print(f"[bench] {name}: {json.dumps(res)[:300]}", file=sys.stderr)
 
         if name == "train-tiny" and "error" not in res:
-            per_chip = res["tokens_per_sec_per_chip"]
-            headline = {
-                "metric": "train_tokens_per_sec_per_chip",
-                "value": per_chip,
-                "unit": "tokens/s/chip",
-                "vs_baseline": round(per_chip / prior, 3) if prior else 1.0,
-                "mfu": res["mfu"],
-                "num_params": res["num_params"],
-                "chips": res["chips"],
-                "step_ms": res["step_ms"],
-                "config": "progen-tiny (dim=512 depth=12 seq=1024 w=256) "
-                          "bf16",
-                "implied_device_tflops": res.get("implied_device_tflops"),
-                "timing_suspect": res.get("timing_suspect", False),
-                "platform": "tpu",
-            }
+            headline = _headline_from(res, prior)
             # print + flush NOW: if a later phase wedges the relay and the
             # driver kills us, the headline is already on stdout
             print(json.dumps(headline), flush=True)
@@ -958,19 +1049,47 @@ def _load_repo_env() -> None:
 if __name__ == "__main__":
     _load_repo_env()
     if len(sys.argv) > 2 and sys.argv[1] == "_phase":
-        if os.environ.get("BENCH_REQUIRE_TPU") == "1":
-            # orchestrated child: the parent already probed; a dead relay
-            # HANGS here and surfaces as the parent's phase timeout, and a
-            # CPU fallback must NOT masquerade as a TPU phase result
-            import jax
+        deadline = int(os.environ.get("BENCH_PHASE_DEADLINE_SEC", "0"))
+        if deadline > 0:
+            import signal
 
-            if not _is_tpu_platform(jax.devices()[0].platform):
-                print("BENCH_REQUIRE_TPU: backend is not TPU",
-                      file=sys.stderr)
-                sys.exit(3)
-        else:
-            _device_or_cpu_fallback()
-        print(json.dumps(run_phase(sys.argv[2])))
+            def _deadline(signum, frame):
+                # raising here (vs being SIGTERM'd by the parent) lets the
+                # phase unwind Python frames and the PJRT client close its
+                # chip claim; only helps when the hang is at Python level,
+                # but that costs nothing and the kill path still backstops
+                raise TimeoutError(
+                    f"phase self-deadline after {deadline}s"
+                )
+
+            signal.signal(signal.SIGALRM, _deadline)
+            signal.alarm(deadline)
+        try:
+            if os.environ.get("BENCH_REQUIRE_TPU") == "1":
+                # orchestrated child: the parent already probed; a dead
+                # relay HANGS here and surfaces as the parent's phase
+                # timeout, and a CPU fallback must NOT masquerade as a
+                # TPU phase result
+                import jax
+
+                if not _is_tpu_platform(jax.devices()[0].platform):
+                    print("BENCH_REQUIRE_TPU: backend is not TPU",
+                          file=sys.stderr)
+                    sys.exit(3)
+            else:
+                _device_or_cpu_fallback()
+            result = run_phase(sys.argv[2])
+            if deadline > 0:
+                # cancel the self-deadline BEFORE teardown: PJRT-client
+                # close over the relay can take seconds, and an alarm
+                # firing mid-teardown would turn this valid result into
+                # an "exit 1" the parent discards
+                signal.alarm(0)
+            print(json.dumps(result))
+        except TimeoutError as e:
+            # clean-unwind path for the self-deadline: report as a phase
+            # error (exit 0 so the parent parses the JSON, not the rc)
+            print(json.dumps({"phase": sys.argv[2], "error": str(e)}))
     elif len(sys.argv) > 1 and sys.argv[1] == "kernel":
         kernel_main()
     elif len(sys.argv) > 2 and sys.argv[1] == "--config":

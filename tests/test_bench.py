@@ -143,6 +143,86 @@ class TestOrchestrator:
         assert "error" in kern[0]  # CPU fallback never masquerades as TPU
 
 
+class TestResume:
+    """main(--resume): after a mid-suite relay wedge, rerun ONLY the
+    missing/errored phases, keep prior clean TPU results, and still emit a
+    headline built from the prior train-tiny record."""
+
+    def test_resume_skips_clean_and_reruns_errored(self, bench, monkeypatch,
+                                                   tmp_path, capsys):
+        import json
+
+        tiny = {
+            "phase": "train-tiny", "config": "tiny",
+            "tokens_per_sec_per_chip": 200000.0, "mfu": 0.36,
+            "step_ms": 80.0, "compile_s": 40.0, "num_params": 51718912,
+            "batch": "4x4x1024", "dtype": "bfloat16",
+            "use_pallas_attn": False, "loss": 0.5, "chips": 1,
+            "platform": "tpu",
+        }
+        suspect = {
+            "phase": "kernel-w512", "fwd_speedup": 9.0, "bwd_speedup": 9.0,
+            "fwd_ms": {}, "bwd_ms": {}, "platform": "tpu",
+            "timing_suspect": True,  # dispatch-rate artifact: NOT keepable
+        }
+        prior = {
+            "schema": "bench-suite-v1", "platform": "tpu",
+            "relay_died_after": "kernel-w256",
+            "phases": [
+                tiny,
+                {"phase": "kernel-w256", "error": "timeout after 420s"},
+                suspect,
+                {"phase": "large-projection", "num_params": 1_200_000_000},
+            ],
+        }
+        detail_path = tmp_path / "BENCH_DETAIL.json"
+        detail_path.write_text(json.dumps(prior))
+
+        monkeypatch.setattr(bench, "_probe_platform", lambda *a, **k: "tpu")
+        monkeypatch.setattr(bench, "_tpu_probe_ok", lambda *a, **k: True)
+        monkeypatch.setattr(bench, "_prior_round_value", lambda: None)
+        monkeypatch.setattr(bench, "_DETAIL_PATH", detail_path)
+        kern = {"phase": "kernel-w256", "fwd_speedup": 1.9,
+                "bwd_speedup": 1.1, "fwd_ms": {}, "bwd_ms": {},
+                "platform": "tpu"}
+        kern512 = {"phase": "kernel-w512", "fwd_speedup": 2.0,
+                   "bwd_speedup": 1.1, "fwd_ms": {}, "bwd_ms": {},
+                   "platform": "tpu"}
+        # train-tiny absent on purpose: a rerun of a clean phase would
+        # KeyError here, failing the test; kernel-w512 present because its
+        # prior record is timing_suspect and MUST be rerun
+        monkeypatch.setattr(
+            bench, "_run_phase_subprocess",
+            lambda name, timeout: {"kernel-w256": kern,
+                                   "kernel-w512": kern512}[name],
+        )
+        monkeypatch.setattr(
+            bench, "_PHASES",
+            (("train-tiny", 60), ("kernel-w256", 60), ("kernel-w512", 60)),
+        )
+        monkeypatch.setenv("BENCH_BUDGET_SEC", "3000")
+        monkeypatch.setattr(sys, "argv", ["bench.py", "--resume"])
+        bench.main()
+
+        lines = capsys.readouterr().out.strip().splitlines()
+        payloads = [json.loads(l) for l in lines if l.startswith("{")]
+        # wedge insurance: the prior headline must be flushed BEFORE any
+        # rerun phase output, then repeated in the final rich line
+        assert payloads[0]["value"] == 200000.0
+        assert "suite" not in payloads[0]
+        final = payloads[-1]
+        assert final["value"] == 200000.0  # headline from the prior record
+        assert final["suite"]["kernel-w256"]["fwd_speedup"] == 1.9
+        detail = json.loads(detail_path.read_text())
+        assert "relay_died_after" not in detail
+        phases = [p["phase"] for p in detail["phases"]]
+        assert phases == ["train-tiny", "kernel-w256", "kernel-w512",
+                          "large-projection"]
+        assert all("error" not in p for p in detail["phases"])
+        w512 = [p for p in detail["phases"] if p["phase"] == "kernel-w512"]
+        assert w512[0]["fwd_speedup"] == 2.0  # fresh, not the suspect 9.0
+
+
 class TestDetailGuard:
     """_write_detail_guarded: an evidence-free record (CPU fallback, or a
     run where the relay died before any phase landed) must never replace a
