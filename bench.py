@@ -314,6 +314,34 @@ def _train_bench(config_name: str, *, use_pallas=None) -> dict:
     peak = profiling.peak_flops(jax.devices()[0])
     per_chip_flops = per_chip * profiling.flops_per_token(config)
     mfu = per_chip_flops / peak
+
+    # XLA's own accounting for the compiled step: how many FLOPs/bytes the
+    # schedule actually executes vs the PaLM-convention model count — the
+    # ratio localizes an MFU gap (masked-window attention waste, remat
+    # recompute, optimizer elementwise traffic) without a trace viewer.
+    # .lower().compile() hits the jit cache, so this costs ~a trace.
+    xla_cost = None
+    try:
+        ca = step.lower(state, device_batch).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        model_flops_step = profiling.flops_per_token(config) * tokens_per_step
+        xla_flops = float(ca.get("flops", 0.0))
+        xla_bytes = float(ca.get("bytes accessed", 0.0))
+        if xla_flops > 0:
+            xla_cost = {
+                "flops_per_step": xla_flops,
+                "bytes_accessed_per_step": xla_bytes,
+                "arithmetic_intensity": round(xla_flops / xla_bytes, 1)
+                if xla_bytes else None,
+                # >1.0 means XLA schedules more FLOPs than the model
+                # convention counts (bwd of fwd-only ops, masked waste…)
+                "flops_vs_model_count": round(
+                    xla_flops / model_flops_step, 3
+                ),
+            }
+    except Exception as e:  # diagnostic only: never fail a timed phase
+        _mark(f"cost_analysis unavailable: {e!r}")
     return {
         "phase": f"train-{config_name}"
         + ("-pallas" if use_pallas else "-xla" if use_pallas is False else ""),
@@ -328,6 +356,7 @@ def _train_bench(config_name: str, *, use_pallas=None) -> dict:
         "use_pallas_attn": config.use_pallas_attn,
         "loss": round(loss_val, 4),
         "chips": n_chips,
+        **({"xla_cost": xla_cost} if xla_cost else {}),
         **_suspect_fields(per_chip_flops, 1.0, peak),  # per_chip_flops is /s
         **_hbm_stats(),
         "platform": jax.devices()[0].platform,
