@@ -137,15 +137,25 @@ class LocalAttentionBlock(nn.Module):
                 q, k, v, window_size=w, mesh=self.mesh
             )
         elif c.use_pallas_attn:
-            from progen_tpu.ops.pallas_attention import pallas_local_attention
+            from progen_tpu.ops.pallas_attention import (
+                measured_impls,
+                pallas_local_attention,
+            )
 
             # positional args: custom_vjp nondiff_argnums are positional.
             # Mosaic-compiled on TPU; interpreter elsewhere, so a config
             # shipping use_pallas_attn=true (long8k.toml) stays runnable
             # on CPU hosts (tests, smoke runs) without monkeypatching.
+            # use_pallas_attn means "best measured kernel combo for this
+            # window", mixing per-direction winners (measured_impls);
+            # pallas_bh_block > 1 in the config overrides the policy's
+            # forward blocking.
             interpret = jax.default_backend() not in ("tpu", "axon")
+            fwd_impl, bwd_impl, g = measured_impls(w)
+            if c.pallas_bh_block > 1:
+                g = c.pallas_bh_block  # explicit config beats the policy
             out = pallas_local_attention(
-                q, k, v, w, None, interpret, "kv", c.pallas_bh_block
+                q, k, v, w, None, interpret, bwd_impl, g, fwd_impl
             )
         else:
             out = local_attention(q, k, v, window_size=w)

@@ -228,7 +228,26 @@ def _flops(bh: int, n: int, d: int, w: int, n_matmuls: int) -> pl.CostEstimate:
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def measured_impls(window_size: int) -> tuple[str, str, int]:
+    """(fwd_impl, bwd_impl, bh_block) winners from the on-chip v5e kernel
+    bench (BENCH_DETAIL_TPU_r3b.json, honest host-fetch-fenced timings):
+
+      w=256: fwd XLA 3.56 ms vs Pallas 3.99 → XLA fwd;
+             bwd halo 8.79 ms vs XLA 10.71 → Pallas halo bwd (1.22x)
+      w=512: fwd Pallas g4 4.02 ms vs XLA 7.87 → Pallas fwd, bh_block=4;
+             bwd kv 10.12 ms vs XLA 10.94 → Pallas kv bwd (1.08x)
+
+    The crossover: at w>=512 the XLA dense path's masked-waste grows
+    faster than the kernel's per-program overhead amortizes, and the
+    kv backward's recompute beats the halo scratch traffic. Mixing is
+    sound because fwd and bwd are independent pallas_call/XLA programs
+    joined only through the (q, k, v) residuals."""
+    if window_size >= 512:
+        return "pallas", "kv", 4
+    return "xla", "halo", 1
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def pallas_local_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -238,6 +257,7 @@ def pallas_local_attention(
     interpret: bool = False,
     bwd_impl: str = "kv",
     bh_block: int = 1,
+    fwd_impl: str = "pallas",
 ) -> jnp.ndarray:
     """q, k, v: (batch, heads, n, dim_head), n % window_size == 0.
     Returns (batch, heads, n, dim_head) in q.dtype. ``interpret=True`` runs
@@ -245,12 +265,17 @@ def pallas_local_attention(
     ``"kv"`` (combined-in-register, default) or ``"halo"`` (f32 halo
     scratch + shifted add) — see the module docstring. ``bh_block``:
     batch-heads per forward program (falls back to 1 when it doesn't
-    divide batch*heads or its f32 probabilities would exceed ~8 MB VMEM);
-    the kernel bench times variants on-chip."""
+    divide batch*heads or its f32 probabilities would exceed ~8 MB VMEM).
+    ``fwd_impl``: ``"pallas"`` or ``"xla"`` — the forward and backward are
+    independently selectable so callers can pair the measured winner per
+    direction (``measured_impls``); the XLA forward still records the same
+    (q, k, v) residuals for the Pallas backward."""
     if bwd_impl not in ("kv", "halo"):
         # validate at the call site, not first-grad-time deep in the VJP
         raise ValueError(f"unknown bwd_impl {bwd_impl!r}")
-    out, _ = _fwd(q, k, v, window_size, scale, interpret, bh_block)
+    if fwd_impl not in ("pallas", "xla"):
+        raise ValueError(f"unknown fwd_impl {fwd_impl!r}")
+    out, _ = _fwd(q, k, v, window_size, scale, interpret, bh_block, fwd_impl)
     return out
 
 
@@ -263,13 +288,23 @@ def _safe_bh_block(bh_block: int, bh: int, w: int) -> int:
     return g
 
 
-def _fwd(q, k, v, window_size, scale, interpret, bh_block=1):
+def _fwd(q, k, v, window_size, scale, interpret, bh_block=1,
+         fwd_impl="pallas"):
     b, h, n, d = q.shape
     w = window_size
     if n % w != 0:
         raise ValueError(f"sequence length {n} not divisible by window {w}")
     if scale is None:
         scale = d ** -0.5
+    if fwd_impl == "xla":
+        # measured winner at small windows (see measured_impls): XLA's
+        # fused dense path computes the primal; the residuals stay (q, k,
+        # v) so the Pallas backward recomputes probabilities identically
+        # to the pure-Pallas path (flash-style recompute either way)
+        from progen_tpu.ops.attention import local_attention
+
+        out = local_attention(q, k, v, window_size=w, scale=scale)
+        return out, (q, k, v)
     bh, nw = b * h, n // w
     g = _safe_bh_block(bh_block, bh, w)
     qf, kf, vf = (t.reshape(bh, n, d) for t in (q, k, v))
@@ -288,11 +323,13 @@ def _fwd(q, k, v, window_size, scale, interpret, bh_block=1):
     return out.reshape(b, h, n, d), (q, k, v)
 
 
-def _fwd_rule(q, k, v, window_size, scale, interpret, bwd_impl, bh_block):
-    return _fwd(q, k, v, window_size, scale, interpret, bh_block)
+def _fwd_rule(q, k, v, window_size, scale, interpret, bwd_impl, bh_block,
+              fwd_impl):
+    return _fwd(q, k, v, window_size, scale, interpret, bh_block, fwd_impl)
 
 
-def _bwd_rule(window_size, scale, interpret, bwd_impl, bh_block, residuals, g):
+def _bwd_rule(window_size, scale, interpret, bwd_impl, bh_block, fwd_impl,
+              residuals, g):
     q, k, v = residuals
     b, h, n, d = q.shape
     w = window_size

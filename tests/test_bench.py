@@ -26,7 +26,8 @@ class TestPhasePlumbing:
             assert timeout > 0
             if name.startswith("train-"):
                 cfg = name[len("train-"):]
-                cfg = cfg.removesuffix("-pallas").removesuffix("-xla")
+                cfg = (cfg.removesuffix("-pallas").removesuffix("-xla")
+                       .removesuffix("-bs32"))
                 assert cfg in bench._RECIPES, name
                 assert (REPO / "configs" / "model" / f"{cfg}.toml").exists()
             elif name.startswith("kernel-w"):
