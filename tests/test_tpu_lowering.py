@@ -64,6 +64,50 @@ class TestTpuLowering:
         )
         assert "tpu_custom_call" in exp.mlir_module()
 
+    @pytest.mark.parametrize(
+        "window,seq", [(256, 512), (512, 1024)]
+    )
+    def test_full_model_grad_lowers_for_tpu(self, window, seq, monkeypatch):
+        """The whole model fwd+bwd with use_pallas_attn — the program the
+        train-*-pallas bench phases Mosaic-compile on-chip. Standalone
+        kernel lowering (above) passed in round 3 while the full train
+        step still timed out on hardware, so the integrated graph (layer
+        stack + custom VJP + the measured_impls mixed path) gets its own
+        offline lowering net. d=64 matches the bench head dim; w picks
+        the policy branch (256 -> xla fwd + halo bwd, 512 -> pallas g4
+        fwd + kv bwd)."""
+        import flax.linen as nn
+
+        from progen_tpu.config import ProGenConfig
+        from progen_tpu.models.progen import ProGen
+        from progen_tpu.training.loss import cross_entropy
+
+        cfg = ProGenConfig(
+            num_tokens=64, dim=128, depth=2, heads=2, dim_head=64,
+            window_size=window, seq_len=seq, global_mlp_depth=1,
+            ff_mult=2, dtype="bfloat16", use_pallas_attn=True,
+        )
+        model = ProGen(cfg)
+        tokens = jnp.zeros((2, seq + 1), jnp.int32)
+        params = nn.meta.unbox(
+            model.init(jax.random.PRNGKey(0), tokens[:, :-1])["params"]
+        )
+
+        def loss_fn(params, tokens):
+            logits = model.apply({"params": params}, tokens[:, :-1])
+            return cross_entropy(logits, tokens[:, 1:]).mean()
+
+        # the layer picks interpret mode off jax.default_backend() (CPU on
+        # this host); exporting FOR tpu must trace the compiled path the
+        # chip will run
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        exp = _export_for_tpu(jax.grad(loss_fn), params, tokens)
+        mlir = exp.mlir_module()
+        # w=256 takes the mixed path: Pallas backward only; w=512 is
+        # Pallas in both directions — either way the custom call must
+        # survive into the TPU module
+        assert "tpu_custom_call" in mlir
+
     @pytest.mark.parametrize("g", [4, 8])
     def test_forward_lowers_for_tpu_bh_block(self, g):
         """The batched (g, w, d) forward blocks must survive the Mosaic
