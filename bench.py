@@ -764,11 +764,45 @@ def _large_projection() -> dict:
     }
 
 
+def _best_archived_tpu_headline() -> dict | None:
+    """Newest honest (non-timing_suspect) TPU train-tiny record from
+    BENCH_DETAIL.json and the in-repo BENCH_DETAIL_TPU_*.json archives —
+    attached to fallback output as provenance (NOT as the fallback's own
+    metric: the fallback never claims a number it didn't measure)."""
+    best = None
+    paths = [_DETAIL_PATH, *sorted(glob.glob(str(_REPO / "BENCH_DETAIL_TPU_*.json")))]
+    for path in paths:
+        try:
+            detail = json.loads(Path(path).read_text())
+        except (OSError, json.JSONDecodeError):
+            continue
+        if detail.get("platform") != "tpu":
+            continue
+        for p in detail.get("phases", []):
+            if (
+                p.get("phase") == "train-tiny"
+                and "error" not in p
+                and not p.get("timing_suspect")
+            ):
+                best = {
+                    "value": p["tokens_per_sec_per_chip"],
+                    "unit": "tokens/s/chip",
+                    "mfu": p["mfu"],
+                    "source": Path(path).name,
+                    "run": detail.get("run", ""),
+                }
+    return best
+
+
 def _cpu_smoke() -> dict:
     """Off-TPU functional smoke (dead relay / CPU host) — the shared
     _train_bench flow at smoke shapes, re-keyed under a DISTINCT metric
-    name so it never poisons the TPU baseline chain."""
+    name so it never poisons the TPU baseline chain. When an honest
+    archived TPU headline exists it rides along as ``last_tpu_record``
+    so a dead-relay round still surfaces the measured baseline (clearly
+    marked as archived, not re-measured)."""
     res = _train_bench("smoke")
+    archived = _best_archived_tpu_headline()
     return {
         "metric": "cpu_fallback_smoke_tokens_per_sec",
         "value": res["tokens_per_sec_per_chip"],
@@ -780,6 +814,7 @@ def _cpu_smoke() -> dict:
         "step_ms": res["step_ms"],
         "config": "cpu-fallback smoke (dim=64 depth=2 seq=128 w=32) f32",
         "platform": res["platform"],
+        **({"last_tpu_record": archived} if archived else {}),
     }
 
 

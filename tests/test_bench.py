@@ -224,6 +224,36 @@ class TestResume:
         assert w512[0]["fwd_speedup"] == 2.0  # fresh, not the suspect 9.0
 
 
+class TestArchivedHeadline:
+    def test_prefers_newest_honest_record(self, bench, monkeypatch,
+                                          tmp_path):
+        import json
+
+        tiny = lambda v, suspect: {
+            "phase": "train-tiny", "tokens_per_sec_per_chip": v,
+            "mfu": 0.3, **({"timing_suspect": True} if suspect else {}),
+        }
+        # archive a: honest; archive b (newer name): suspect-only
+        (tmp_path / "BENCH_DETAIL_TPU_a.json").write_text(json.dumps(
+            {"platform": "tpu", "run": "a", "phases": [tiny(111.0, False)]}
+        ))
+        (tmp_path / "BENCH_DETAIL_TPU_b.json").write_text(json.dumps(
+            {"platform": "tpu", "run": "b", "phases": [tiny(999.0, True)]}
+        ))
+        monkeypatch.setattr(bench, "_REPO", tmp_path)
+        monkeypatch.setattr(bench, "_DETAIL_PATH",
+                            tmp_path / "BENCH_DETAIL.json")
+        rec = bench._best_archived_tpu_headline()
+        # the suspect 999.0 must lose to the honest 111.0
+        assert rec["value"] == 111.0 and rec["source"].endswith("a.json")
+
+    def test_none_when_no_honest_record(self, bench, monkeypatch, tmp_path):
+        monkeypatch.setattr(bench, "_REPO", tmp_path)
+        monkeypatch.setattr(bench, "_DETAIL_PATH",
+                            tmp_path / "BENCH_DETAIL.json")
+        assert bench._best_archived_tpu_headline() is None
+
+
 class TestDetailGuard:
     """_write_detail_guarded: an evidence-free record (CPU fallback, or a
     run where the relay died before any phase landed) must never replace a
