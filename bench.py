@@ -145,6 +145,7 @@ _PHASES = (
     ("train-long8k", 1500),
     ("train-tiny-pallas", 1500),
     ("decode-tiny", 600),
+    ("profile-tiny", 420),  # artifact-only; last, fully expendable
 )
 
 # per-config bench recipes: (grad_accum, micro_batch, iters)
@@ -257,11 +258,14 @@ def _load_config(name: str, **overrides):
 
 
 def _train_bench(config_name: str, *, use_pallas=None, recipe=None,
-                 phase_suffix: str = "") -> dict:
+                 phase_suffix: str = "", profile_dir: str | None = None) -> dict:
     """One measured train-step benchmark for a named config. Returns the
     result dict (also JSON-printed by the _phase entry point). ``recipe``
     overrides the (grad_accum, micro_batch, iters) table — used by the
-    ceiling phases that lift the reference-parity batch."""
+    ceiling phases that lift the reference-parity batch. ``profile_dir``
+    wraps the timed loop in a jax.profiler trace (the profile phase)."""
+    import contextlib
+
     import jax
 
     from progen_tpu import profiling
@@ -311,10 +315,16 @@ def _train_bench(config_name: str, *, use_pallas=None, recipe=None,
         _mark(f"compile+first step done in {compile_s:.1f}s; timing "
               f"{n_iters} iters")
 
+        tracing = (
+            jax.profiler.trace(profile_dir)
+            if profile_dir
+            else contextlib.nullcontext()
+        )
         t0 = time.perf_counter()
-        for _ in range(n_iters):
-            state, metrics = compiled(state, device_batch)
-        loss_val = float(metrics["loss"])
+        with tracing:
+            for _ in range(n_iters):
+                state, metrics = compiled(state, device_batch)
+            loss_val = float(metrics["loss"])
         dt = time.perf_counter() - t0
         _mark(f"timed loop done in {dt:.1f}s")
 
@@ -823,6 +833,18 @@ def run_phase(name: str) -> dict:
         return _kernel_bench(int(name[len("kernel-w"):]))
     if name == "train-tiny-pallas":
         return _train_bench("tiny", use_pallas=True)
+    if name == "profile-tiny":
+        # on-chip trace artifact for offline schedule analysis (where the
+        # step's time actually goes — the MFU-gap question cost_analysis
+        # can't answer). Loses its timing honesty to profiler overhead,
+        # which is fine: this phase's product is the trace, not a number.
+        prof = str(_LOG_DIR.parent / "profiles" / "tiny")
+        res = _train_bench("tiny", recipe=(4, 4, 3),
+                           phase_suffix="-profile", profile_dir=prof)
+        res["phase"] = "profile-tiny"  # match the scheduled phase name
+        res["trace_dir"] = prof
+        res["timing_suspect"] = True  # profiler overhead: not a baseline
+        return res
     if name == "train-tiny-bs32":
         # framework-ceiling companion to the recipe-parity headline: same
         # model, micro-batch 32 / no accumulation — MFU at a batch the
