@@ -42,6 +42,23 @@ class TestPhasePlumbing:
         # chain must stay unpolluted (None until a platform=tpu record)
         assert bench._prior_round_value() is None
 
+    def test_prior_round_uses_fallback_carried_tpu_record(
+            self, bench, monkeypatch, tmp_path):
+        import json
+
+        # a dead-relay round whose fallback smoke carries the archived
+        # honest headline must keep the vs_baseline chain alive
+        (tmp_path / "BENCH_r03.json").write_text(json.dumps({
+            "parsed": {
+                "metric": "cpu_fallback_smoke_tokens_per_sec",
+                "value": 33000.0, "platform": "cpu",
+                "last_tpu_record": {"value": 206369.0,
+                                    "source": "BENCH_DETAIL_TPU_r3b.json"},
+            }
+        }))
+        monkeypatch.setattr(bench, "_REPO", tmp_path)
+        assert bench._prior_round_value() == 206369.0
+
     def test_large_projection_math(self, bench):
         res = bench._large_projection()
         assert res["num_params"] > 1.2e9  # the 1.2B BASELINE.md config
