@@ -187,6 +187,53 @@ class TestModelIntegration:
         np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
 
 
+class TestLayerPolicyDispatch:
+    """The attention layer must hand pallas_local_attention the
+    measured-winner impls for its window (and honor the config's explicit
+    bh_block override)."""
+
+    def _recorded_call(self, monkeypatch, window, seq, bh_block=1):
+        import progen_tpu.models.layers as layers_mod
+        import progen_tpu.ops.pallas_attention as pa
+        from progen_tpu.config import ProGenConfig
+        from progen_tpu.models.progen import ProGen
+
+        calls = []
+        real = pa.pallas_local_attention
+
+        def recorder(q, k, v, w, scale, interpret, bwd_impl, g, fwd_impl):
+            calls.append((w, bwd_impl, g, fwd_impl))
+            # always run the cheap XLA path: this test pins dispatch, not
+            # kernel numerics (covered elsewhere)
+            return real(q, k, v, w, scale, True, bwd_impl, 1, "xla")
+
+        monkeypatch.setattr(pa, "pallas_local_attention", recorder)
+        cfg = ProGenConfig(
+            num_tokens=32, dim=32, seq_len=seq, depth=1,
+            window_size=window, global_mlp_depth=0, heads=2, dim_head=16,
+            ff_mult=2, dtype="float32", use_pallas_attn=True,
+            pallas_bh_block=bh_block,
+        )
+        model = ProGen(cfg)
+        tokens = jnp.zeros((1, seq), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+        model.apply({"params": params}, tokens)
+        return calls
+
+    def test_small_window_gets_mixed_impls(self, monkeypatch):
+        calls = self._recorded_call(monkeypatch, window=8, seq=32)
+        assert calls and calls[-1] == (8, "halo", 1, "xla")
+
+    def test_large_window_gets_pallas_impls(self, monkeypatch):
+        calls = self._recorded_call(monkeypatch, window=512, seq=1024)
+        assert calls and calls[-1] == (512, "kv", 4, "pallas")
+
+    def test_config_bh_block_overrides_policy(self, monkeypatch):
+        calls = self._recorded_call(monkeypatch, window=512, seq=1024,
+                                    bh_block=2)
+        assert calls and calls[-1][2] == 2
+
+
 class TestBhBlock:
     """bh_block > 1: g batch-heads' windows per forward program — must be
     numerically identical to g=1 (same math, fatter blocks), with graceful
