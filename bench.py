@@ -136,6 +136,7 @@ _PHASES = (
     ("train-tiny", 720),
     ("calib-matmul", 300),  # fence calibration: known-FLOPs matmul chain
     ("train-tiny-bs32", 420),  # ceiling companion: bs=32, no accum
+    ("train-tiny-scan", 720),  # XLA twin of train-tiny-pallas's structure
     ("kernel-w256", 420),
     ("kernel-w512", 420),
     ("train-default", 600),
@@ -264,7 +265,8 @@ def _load_config(name: str, **overrides):
 
 
 def _train_bench(config_name: str, *, use_pallas=None, recipe=None,
-                 phase_suffix: str = "", profile_dir: str | None = None) -> dict:
+                 phase_suffix: str = "", profile_dir: str | None = None,
+                 extra_overrides: dict | None = None) -> dict:
     """One measured train-step benchmark for a named config. Returns the
     result dict (also JSON-printed by the _phase entry point). ``recipe``
     overrides the (grad_accum, micro_batch, iters) table — used by the
@@ -280,7 +282,7 @@ def _train_bench(config_name: str, *, use_pallas=None, recipe=None,
     from progen_tpu.training.optimizer import make_optimizer
     from progen_tpu.training.step import compile_train_step, init_train_state
 
-    overrides = {}
+    overrides = dict(extra_overrides or {})
     if use_pallas is not None:
         overrides["use_pallas_attn"] = use_pallas
     config = _load_config(config_name, **overrides)
@@ -379,6 +381,7 @@ def _train_bench(config_name: str, *, use_pallas=None, recipe=None,
         "batch": f"{grad_accum}x{micro_bs}x{config.seq_len}",
         "dtype": config.dtype,
         "use_pallas_attn": config.use_pallas_attn,
+        "scan_layers": config.scan_layers,
         "loss": round(loss_val, 4),
         "chips": n_chips,
         **({"xla_cost": xla_cost} if xla_cost else {}),
@@ -838,7 +841,16 @@ def run_phase(name: str) -> dict:
     if name.startswith("kernel-w"):
         return _kernel_bench(int(name[len("kernel-w"):]))
     if name == "train-tiny-pallas":
-        return _train_bench("tiny", use_pallas=True)
+        # scan_layers: one scanned body = ~3 embedded Mosaic kernel
+        # instances instead of the unrolled stack's 12+ — each is a
+        # separate slow remote compile on this relay (the round-3 720s
+        # timeout). Compare against train-tiny-scan, its XLA twin with
+        # the same layer structure.
+        return _train_bench("tiny", use_pallas=True,
+                            extra_overrides={"scan_layers": True})
+    if name == "train-tiny-scan":
+        return _train_bench("tiny", phase_suffix="-scan",
+                            extra_overrides={"scan_layers": True})
     if name == "profile-tiny":
         # on-chip trace artifact for offline schedule analysis (where the
         # step's time actually goes — the MFU-gap question cost_analysis

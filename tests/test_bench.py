@@ -27,7 +27,7 @@ class TestPhasePlumbing:
             if name.startswith("train-"):
                 cfg = name[len("train-"):]
                 cfg = (cfg.removesuffix("-pallas").removesuffix("-xla")
-                       .removesuffix("-bs32"))
+                       .removesuffix("-bs32").removesuffix("-scan"))
                 assert cfg in bench._RECIPES, name
                 assert (REPO / "configs" / "model" / f"{cfg}.toml").exists()
             elif name.startswith("kernel-w"):
