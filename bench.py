@@ -16,9 +16,15 @@ taking the parent down):
   2. kernel-w256/512  — Pallas local-attention kernel vs the XLA path,
                         fwd+bwd, Mosaic-compiled (VERDICT round-2 item 2),
                         including on-chip max-abs-error vs the golden.
-  3. train-tiny-pallas— the flagship with use_pallas_attn, vs phase 1.
-  4. train-long8k[-xla]— long-context config (8192/512, remat), Pallas per
-                        its TOML vs forced-XLA, side by side.
+  3. train-tiny-pallas— the flagship with use_pallas_attn + scan_layers
+                        (one scanned body = few Mosaic instances; the
+                        unrolled stack's 12+ separate remote kernel
+                        compiles blew a 720s timeout in round 3). Its
+                        controlled comparison is train-tiny-scan, the XLA
+                        twin with the same layer structure — train-tiny
+                        (phase 1) differs in two variables.
+  4. train-long8k[-xla]— long-context config (8192/512, remat+scan),
+                        Pallas per its TOML vs forced-XLA, side by side.
   5. train-default / train-base — remaining BASELINE.md configs.
   6. large-projection — ProGen-large (1.2B) HBM/flops sharding study
                         (single chip can't hold 1.2B x 16B/param; the
@@ -478,7 +484,16 @@ def _kernel_bench(window: int) -> dict:
     # scratch + shifted add) — the on-chip winner informs the default
     t_pb = {}
     bwd_err = {}
-    for impl in ("kv", "halo"):
+    bwd_impls = ["kv", "halo"]
+    # batched kv variants (same lever as the forward's bh_block; VMEM cap
+    # uses n_probs=2 — two probability tensors live per program)
+    timed_bwd_gs = {1}
+    for g_try in (4, 8):
+        g_eff = _safe_bh_block(g_try, b * h, w, n_probs=2)
+        if g_eff not in timed_bwd_gs:
+            timed_bwd_gs.add(g_eff)
+            bwd_impls.append(f"kv_g{g_eff}")
+    for impl in bwd_impls:
         t_pb[impl], g_p = time_fn(pl_bwd(impl), iters_b)
         bwd_err[impl] = max(
             float(
@@ -506,8 +521,8 @@ def _kernel_bench(window: int) -> dict:
         "fwd_bh_block_err": {k: v["max_err"] for k, v in fwd_ms_g.items()},
         "bwd_ms": {
             "xla": round(t_xb * 1e3, 3),
-            "pallas_kv": round(t_pb["kv"] * 1e3, 3),
-            "pallas_halo": round(t_pb["halo"] * 1e3, 3),
+            **{f"pallas_{impl}": round(t * 1e3, 3)
+               for impl, t in t_pb.items()},
         },
         "fwd_speedup": round(t_xf / t_pf_best, 2),  # best pallas variant
         "bwd_speedup": round(t_xb / t_pb[best], 2),

@@ -21,7 +21,9 @@ including the window-0 zero-key softmax dilution). Design:
       score recompute, but NO f32 halo scratch in HBM and no combine
       pass — windowed attention is bandwidth-bound, so trading one (w,2w)
       matmul for 2x duplicated f32 k/v-grad HBM traffic is the
-      TPU-friendly direction.
+      TPU-friendly direction. ``"kv_g<N>"`` runs the same kernel with N
+      batch-heads per program (the forward's bh_block lever, bench-
+      selectable).
     - ``"halo"`` — q-centric: each program emits dq for its window and
       d(k2)/d(v2) for its [prev|cur] halo pair as (bh, nw, 2w, d) f32
       scratch, and the halo overlap is resolved OUTSIDE the kernel by one
@@ -75,15 +77,7 @@ def _fwd_kernel(q_ref, kp_ref, kc_ref, vp_ref, vc_ref, o_ref, *, scale):
     v2 = jnp.concatenate(
         [vp_ref[...].astype(f32) * not_first, vc_ref[...].astype(f32)], axis=1
     )
-    s = jax.lax.dot_general(  # (g, w, 2w)
-        q, k2,
-        dimension_numbers=(((2,), (2,)), ((0,), (0,))),
-        preferred_element_type=f32,
-    ) * scale
-    s = jnp.where(_window_mask(w)[None], s, ATTN_MASK_VALUE)
-    s = s - s.max(axis=-1, keepdims=True)
-    e = jnp.exp(s)
-    p = e / e.sum(axis=-1, keepdims=True)
+    p = _softmax_rows_batched(q, k2, w, scale)  # (g, w, 2w)
     o = jax.lax.dot_general(  # (g, w, d)
         p, v2,
         dimension_numbers=(((2,), (1,)), ((0,), (0,))),
@@ -123,7 +117,8 @@ def _bwd_kernel(
 
 def _softmax_row(q, k2, w, scale):
     """Masked softmax probabilities for one window's (w, 2w) attention
-    row (shared by the forward and both backward recomputes)."""
+    row (the halo backward's recompute; the forward and kv backward use
+    the g-batched twin below)."""
     s = jax.lax.dot_general(
         q, k2,
         dimension_numbers=(((1,), (1,)), ((), ())),
@@ -144,54 +139,87 @@ def _ds_from(p, do, v2):
     return p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
 
 
-def _bwd_kv_kernel(
+def _softmax_rows_batched(q, k2, w, scale):
+    """(g, w, d) x (g, 2w, d) -> (g, w, 2w) masked softmax (the g-batched
+    twin of _softmax_row; same mask, same f32 accumulation)."""
+    s = jax.lax.dot_general(
+        q, k2,
+        dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    s = jnp.where(_window_mask(w)[None], s, ATTN_MASK_VALUE)
+    s = s - s.max(axis=-1, keepdims=True)
+    e = jnp.exp(s)
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+def _ds_from_batched(p, do, v2):
+    dp = jax.lax.dot_general(  # (g, w, 2w)
+        do, v2,
+        dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+    return p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
+
+
+def _bwd_kv_kernel_batched(
     qc_ref, qn_ref, doc_ref, don_ref,
     kp_ref, kc_ref, kn_ref, vp_ref, vc_ref, vn_ref,
     dq_ref, dk_ref, dv_ref, *, scale,
 ):
-    """kv-centric backward: program j owns k_j/v_j, whose only consumers
-    are query windows j ([prev|CUR] half) and j+1 ([PREV|cur] half).
-    Recompute both softmax rows and emit dq_j, dk_j, dv_j fully combined —
-    no halo scratch, no post-kernel combine."""
+    """kv-centric backward over (g, w, d) blocks: program j owns k_j/v_j,
+    whose only consumers are query windows j ([prev|CUR] half) and j+1
+    ([PREV|cur] half); recompute both softmax rows and emit dq_j, dk_j,
+    dv_j fully combined — no halo scratch, no post-kernel combine. g=1 is
+    the one-window-per-program layout; larger g batches g batch-heads per
+    program for fatter MXU tiles (the lever that wins the w=512 forward).
+    VMEM cost doubles vs the forward's g blocks — two (g, w, 2w) f32
+    probability tensors live at once — so _safe_bh_block gets n_probs=2."""
     w = qc_ref.shape[1]
     f32 = jnp.float32
     j = pl.program_id(1)
     not_first = (j > 0).astype(f32)
     has_next = (j < pl.num_programs(1) - 1).astype(f32)
 
-    qc, doc = qc_ref[0].astype(f32), doc_ref[0].astype(f32)
-    kc, vc = kc_ref[0].astype(f32), vc_ref[0].astype(f32)
+    qc = qc_ref[...].astype(f32)  # (g, w, d)
+    doc = doc_ref[...].astype(f32)
+    kc = kc_ref[...].astype(f32)
+    vc = vc_ref[...].astype(f32)
 
-    # ---- row j: k2 = [k_{j-1} | k_j] (zeroed at j == 0) ----
-    k2 = jnp.concatenate([kp_ref[0].astype(f32) * not_first, kc], axis=0)
-    v2 = jnp.concatenate([vp_ref[0].astype(f32) * not_first, vc], axis=0)
-    p = _softmax_row(qc, k2, w, scale)
-    ds = _ds_from(p, doc, v2)
+    # row j: k2 = [k_{j-1} | k_j], zeroed at j == 0
+    k2 = jnp.concatenate([kp_ref[...].astype(f32) * not_first, kc], axis=1)
+    v2 = jnp.concatenate([vp_ref[...].astype(f32) * not_first, vc], axis=1)
+    p = _softmax_rows_batched(qc, k2, w, scale)
+    ds = _ds_from_batched(p, doc, v2)
 
-    dq_ref[0] = (
-        jnp.dot(ds, k2, preferred_element_type=f32) * scale
+    dq_ref[...] = (
+        jax.lax.dot_general(  # (g, w, d)
+            ds, k2,
+            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=f32,
+        ) * scale
     ).astype(dq_ref.dtype)
-    # current-half contributions to dk_j / dv_j
-    tq = lambda a, b: jax.lax.dot_general(  # a^T @ b -> (w, d)
+
+    tq = lambda a, b: jax.lax.dot_general(  # a^T @ b per g -> (g, w, d)
         a, b,
-        dimension_numbers=(((0,), (0,)), ((), ())),
+        dimension_numbers=(((1,), (1,)), ((0,), (0,))),
         preferred_element_type=f32,
     )
-    dk = tq(ds[:, w:], qc) * scale
-    dv = tq(p[:, w:], doc)
+    dk = tq(ds[:, :, w:], qc) * scale
+    dv = tq(p[:, :, w:], doc)
 
-    # ---- row j+1: k2 = [k_j | k_{j+1}] (garbage at the clamped last
-    # program, zeroed via has_next) ----
-    qn, don = qn_ref[0].astype(f32), don_ref[0].astype(f32)
-    k2n = jnp.concatenate([kc, kn_ref[0].astype(f32)], axis=0)
-    v2n = jnp.concatenate([vc, vn_ref[0].astype(f32)], axis=0)
-    pn = _softmax_row(qn, k2n, w, scale)
-    dsn = _ds_from(pn, don, v2n)
-    dk = dk + has_next * tq(dsn[:, :w], qn) * scale
-    dv = dv + has_next * tq(pn[:, :w], don)
+    # row j+1: k2 = [k_j | k_{j+1}], zeroed past the clamped last program
+    qn = qn_ref[...].astype(f32)
+    don = don_ref[...].astype(f32)
+    k2n = jnp.concatenate([kc, kn_ref[...].astype(f32)], axis=1)
+    v2n = jnp.concatenate([vc, vn_ref[...].astype(f32)], axis=1)
+    pn = _softmax_rows_batched(qn, k2n, w, scale)
+    dsn = _ds_from_batched(pn, don, v2n)
+    dk = dk + has_next * tq(dsn[:, :, :w], qn) * scale
+    dv = dv + has_next * tq(pn[:, :, :w], don)
 
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
 
 
 def _index_maps(w: int, d: int, g: int = 1):
@@ -226,6 +254,18 @@ def _flops(bh: int, n: int, d: int, w: int, n_matmuls: int) -> pl.CostEstimate:
         transcendentals=bh * n * 2 * w,
         bytes_accessed=4 * bh * n * d * 4,
     )
+
+
+def _parse_bwd_impl(bwd_impl: str) -> tuple[str, int] | None:
+    """"kv" / "halo" / "kv_g<N>" -> (base_impl, g); None if unknown.
+    The kv_g variants run the g-batched kv backward — same math, g
+    batch-heads per program (kernel-bench-selectable like the forward's
+    bh_block)."""
+    if bwd_impl in ("kv", "halo"):
+        return bwd_impl, 1
+    if bwd_impl.startswith("kv_g") and bwd_impl[4:].isdigit():
+        return "kv", int(bwd_impl[4:])
+    return None
 
 
 def measured_impls(window_size: int) -> tuple[str, str, int]:
@@ -264,13 +304,16 @@ def pallas_local_attention(
     the kernel in the Pallas interpreter (CPU tests). ``bwd_impl``:
     ``"kv"`` (combined-in-register, default) or ``"halo"`` (f32 halo
     scratch + shifted add) — see the module docstring. ``bh_block``:
-    batch-heads per forward program (falls back to 1 when it doesn't
-    divide batch*heads or its f32 probabilities would exceed ~8 MB VMEM).
+    batch-heads per FORWARD program (falls back to 1 when it doesn't
+    divide batch*heads or its f32 probabilities would exceed ~8 MB VMEM);
+    the backward's batching is selected independently via
+    ``bwd_impl="kv_g<N>"`` so each direction runs only its
+    on-chip-measured winner.
     ``fwd_impl``: ``"pallas"`` or ``"xla"`` — the forward and backward are
     independently selectable so callers can pair the measured winner per
     direction (``measured_impls``); the XLA forward still records the same
     (q, k, v) residuals for the Pallas backward."""
-    if bwd_impl not in ("kv", "halo"):
+    if _parse_bwd_impl(bwd_impl) is None:
         # validate at the call site, not first-grad-time deep in the VJP
         raise ValueError(f"unknown bwd_impl {bwd_impl!r}")
     if fwd_impl not in ("pallas", "xla"):
@@ -279,10 +322,11 @@ def pallas_local_attention(
     return out
 
 
-def _safe_bh_block(bh_block: int, bh: int, w: int) -> int:
-    """Largest usable g <= bh_block: must divide bh and keep the (g, w, 2w)
-    f32 probabilities within ~8 MB of VMEM."""
-    g = max(1, min(bh_block, (8 << 20) // (w * 2 * w * 4) or 1))
+def _safe_bh_block(bh_block: int, bh: int, w: int, n_probs: int = 1) -> int:
+    """Largest usable g <= bh_block: must divide bh and keep the n_probs
+    (g, w, 2w) f32 probability tensors within ~8 MB of VMEM (the batched
+    kv backward holds two at once)."""
+    g = max(1, min(bh_block, (8 << 20) // (n_probs * w * 2 * w * 4) or 1))
     while bh % g:
         g -= 1
     return g
@@ -339,12 +383,18 @@ def _bwd_rule(window_size, scale, interpret, bwd_impl, bh_block, fwd_impl,
     qf, kf, vf = (t.reshape(bh, n, d) for t in (q, k, v))
     gf = g.reshape(bh, n, d)
 
-    if bwd_impl == "kv":
-        cur, prev, spec = _index_maps(w, d)
+    parsed = _parse_bwd_impl(bwd_impl)
+    if parsed is None:
+        raise ValueError(f"unknown bwd_impl {bwd_impl!r}")
+    base_impl, g_req = parsed
+
+    if base_impl == "kv":
+        g_bwd = _safe_bh_block(g_req, bh, w, n_probs=2)
+        cur, prev, spec = _index_maps(w, d, g_bwd)
         nxt = lambda b_, i: (b_, jnp.minimum(i + 1, nw - 1), 0)
         dq, dk, dv = pl.pallas_call(
-            functools.partial(_bwd_kv_kernel, scale=scale),
-            grid=(bh, nw),
+            functools.partial(_bwd_kv_kernel_batched, scale=scale),
+            grid=(bh // g_bwd, nw),
             in_specs=[
                 spec(cur), spec(nxt),              # q_j, q_{j+1}
                 spec(cur), spec(nxt),              # do_j, do_{j+1}
@@ -362,8 +412,6 @@ def _bwd_rule(window_size, scale, interpret, bwd_impl, bh_block, fwd_impl,
             interpret=interpret,
         )(qf, qf, gf, gf, kf, kf, kf, vf, vf, vf)
         return tuple(t.reshape(b, h, n, d) for t in (dq, dk, dv))
-    if bwd_impl != "halo":
-        raise ValueError(f"unknown bwd_impl {bwd_impl!r}")
 
     halo_block = pl.BlockSpec(
         (1, 1, 2 * w, d), lambda b_, i: (b_, i, 0, 0), memory_space=pltpu.VMEM

@@ -90,6 +90,33 @@ class TestPallasBackward:
                 a, b, atol=1e-5, rtol=1e-5, err_msg=f"d{name} mismatch"
             )
 
+    @pytest.mark.parametrize("g", [2, 4])
+    def test_kv_batched_matches_g1(self, g):
+        """kv_g<N>: identical math to kv, g batch-heads per program."""
+        q, k, v = _qkv(10, (2, 2, 64, 16))  # bh = 4
+
+        def grads(impl):
+            return jax.grad(
+                lambda q, k, v: pallas_local_attention(
+                    q, k, v, 16, None, True, impl
+                ).sum(),
+                argnums=(0, 1, 2),
+            )(q, k, v)
+
+        for a, b, name in zip(grads("kv"), grads(f"kv_g{g}"), "qkv"):
+            np.testing.assert_allclose(
+                a, b, atol=1e-6, rtol=1e-6, err_msg=f"d{name} mismatch"
+            )
+
+    def test_kv_batched_non_dividing_falls_back(self):
+        q, k, v = _qkv(11, (2, 3, 32, 8))  # bh = 6: g=4 -> largest is 3
+
+        ga = jax.grad(lambda q: pallas_local_attention(
+            q, k, v, 8, None, True, "kv_g4").sum())(q)
+        gb = jax.grad(lambda q: pallas_local_attention(
+            q, k, v, 8, None, True, "kv").sum())(q)
+        np.testing.assert_allclose(ga, gb, atol=1e-6, rtol=1e-6)
+
     @pytest.mark.parametrize("bwd_impl", ["kv", "halo"])
     def test_last_window_keys_get_gradient(self, bwd_impl):
         """Neither backward may drop the final window's k/v gradient."""

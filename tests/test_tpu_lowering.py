@@ -31,7 +31,7 @@ class TestTpuLowering:
         mlir = exp.mlir_module()
         assert "tpu_custom_call" in mlir  # the Mosaic kernel made it in
 
-    @pytest.mark.parametrize("bwd_impl", ["kv", "halo"])
+    @pytest.mark.parametrize("bwd_impl", ["kv", "halo", "kv_g4", "kv_g8"])
     def test_backward_lowers_for_tpu(self, bwd_impl):
         q = jnp.zeros((2, 8, 1024, 64), jnp.bfloat16)
 
@@ -43,7 +43,7 @@ class TestTpuLowering:
         exp = _export_for_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, q, q)
         assert "tpu_custom_call" in exp.mlir_module()
 
-    @pytest.mark.parametrize("bwd_impl", ["kv", "halo"])
+    @pytest.mark.parametrize("bwd_impl", ["kv", "halo", "kv_g4"])
     def test_backward_lowers_for_tpu_w512(self, bwd_impl):
         # the long8k shapes: w=512 is where VMEM pressure peaks
         q = jnp.zeros((1, 8, 2048, 64), jnp.bfloat16)
