@@ -65,9 +65,17 @@ class TestTpuLowering:
         assert "tpu_custom_call" in exp.mlir_module()
 
     @pytest.mark.parametrize(
-        "window,seq", [(256, 512), (512, 1024)]
+        "window,seq,structure",
+        [
+            # tiny-pallas phase structure: scan, no remat
+            (256, 512, {"scan_layers": True}),
+            # long8k.toml structure: scan + remat + blocked SGU
+            (512, 1024, {"scan_layers": True, "remat": True,
+                         "sgu_block_size": 512}),
+        ],
     )
-    def test_full_model_grad_lowers_for_tpu(self, window, seq, monkeypatch):
+    def test_full_model_grad_lowers_for_tpu(self, window, seq, structure,
+                                            monkeypatch):
         """The whole model fwd+bwd with use_pallas_attn — the program the
         train-*-pallas bench phases Mosaic-compile on-chip. Standalone
         kernel lowering (above) passed in round 3 while the full train
@@ -86,6 +94,7 @@ class TestTpuLowering:
             num_tokens=64, dim=128, depth=2, heads=2, dim_head=64,
             window_size=window, seq_len=seq, global_mlp_depth=1,
             ff_mult=2, dtype="bfloat16", use_pallas_attn=True,
+            **structure,
         )
         model = ProGen(cfg)
         tokens = jnp.zeros((2, seq + 1), jnp.int32)
