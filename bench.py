@@ -749,6 +749,81 @@ def _decode_bench() -> dict:
     }
 
 
+def _data_io_bench() -> dict:
+    """Host-side input-pipeline throughput: the from-scratch TFRecord
+    codec (write + parse) and the C++ engine vs the pure-Python path, plus
+    native batch collation — at Uniref50-like record sizes. No chip
+    involved (platform "host", exempt from the TPU gate): this is the
+    runtime the reference delegates to tf.data, measured as the framework
+    component it is."""
+    import gzip
+    import tempfile
+
+    rng = np.random.default_rng(0)
+    n_rec = 20000
+    seqs = [
+        bytes(rng.integers(65, 90, size=int(L)).astype(np.uint8))
+        for L in rng.integers(200, 1024, size=n_rec)
+    ]
+    total_mb = sum(len(s) for s in seqs) / 1e6
+
+    from progen_tpu.data import _native
+    from progen_tpu.data.dataset import collate as py_collate
+    from progen_tpu.data.tfrecord import (
+        encode_example,
+        read_tfrecords,
+        tfrecord_writer,
+    )
+
+    with tempfile.TemporaryDirectory() as td:
+        path = f"{td}/bench.{n_rec}.tfrecord.gz"
+        t0 = time.perf_counter()
+        with tfrecord_writer(path) as write:
+            for s in seqs:
+                write(s)
+        t_write = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        out = list(read_tfrecords(path))
+        t_py = time.perf_counter() - t0
+        assert len(out) == n_rec and out[0] == seqs[0]
+
+        lib = _native.load()
+        t_cc = None
+        if lib is not None:
+            with gzip.open(path, "rb") as f:
+                raw = f.read()
+            t0 = time.perf_counter()
+            out_cc = _native.parse_file(raw)
+            t_cc = time.perf_counter() - t0
+            assert list(out_cc) == out
+
+        t0 = time.perf_counter()
+        py_collate(out[:4096], 1024)
+        t_collate = time.perf_counter() - t0
+
+    return {
+        "phase": "data-io",
+        "host_side": True,
+        "records": n_rec,
+        "payload_mb": round(total_mb, 1),
+        "write_mb_s": round(total_mb / t_write, 1),
+        "parse_py_records_s": round(n_rec / t_py, 0),
+        "parse_py_mb_s": round(total_mb / t_py, 1),
+        **(
+            {
+                "parse_native_records_s": round(n_rec / t_cc, 0),
+                "parse_native_mb_s": round(total_mb / t_cc, 1),
+                "native_speedup": round(t_py / t_cc, 2),
+            }
+            if t_cc is not None
+            else {"native_speedup": None}
+        ),
+        "collate_4096x1024_ms": round(t_collate * 1e3, 1),
+        "platform": "host",
+    }
+
+
 def _large_projection() -> dict:
     """ProGen-large (1.2B) sharding study — no chip run: the optimizer
     state alone (f32 params + AdamW m/v = 12 B/param) plus transient f32
@@ -828,6 +903,15 @@ def _best_archived_tpu_headline() -> dict | None:
     return best
 
 
+def _data_io_safe() -> dict:
+    """_data_io_bench that degrades to an error record instead of killing
+    the run (it builds the C++ engine on first use)."""
+    try:
+        return _data_io_bench()
+    except Exception as e:
+        return {"phase": "data-io", "error": repr(e)[:300]}
+
+
 def _cpu_smoke() -> dict:
     """Off-TPU functional smoke (dead relay / CPU host) — the shared
     _train_bench flow at smoke shapes, re-keyed under a DISTINCT metric
@@ -897,6 +981,8 @@ def run_phase(name: str) -> dict:
         return _sgu_mix_bench()
     if name == "large-projection":
         return _large_projection()
+    if name == "data-io":
+        return _data_io_bench()
     raise ValueError(f"unknown phase {name}")
 
 
@@ -914,10 +1000,11 @@ def _write_detail(detail: dict, path: Path | None = None) -> None:
 
 def _has_tpu_evidence(detail: dict) -> bool:
     """True only for ON-CHIP phase results: the closed-form
-    large-projection study and metric-only smoke entries run without a
-    chip, so they never count as evidence."""
+    large-projection study, host-side phases (data-io), and metric-only
+    smoke entries run without a chip, so they never count as evidence."""
     return detail.get("platform") == "tpu" and any(
         "error" not in p
+        and not p.get("host_side")
         and p.get("phase") not in (None, "large-projection")
         for p in detail.get("phases", [])
     )
@@ -1059,7 +1146,10 @@ def main() -> None:
                 if p.get("phase")  # drops the phase-less _cpu_smoke record
                 and "error" not in p
                 and not p.get("timing_suspect")
-                and _is_tpu_platform(p.get("platform", "tpu"))
+                and (
+                    _is_tpu_platform(p.get("platform", "tpu"))
+                    or p.get("host_side")  # chip-free phases keep anywhere
+                )
                 and p["phase"] != "large-projection"
             ]
             done = {p["phase"] for p in detail["phases"]}
@@ -1068,6 +1158,7 @@ def main() -> None:
         _force_cpu()
         result = _cpu_smoke()
         detail["phases"].append(result)
+        detail["phases"].append(_data_io_safe())
         detail["phases"].append(_large_projection())
         _write_detail_guarded(detail)
         print(json.dumps(result), flush=True)
@@ -1121,6 +1212,8 @@ def main() -> None:
                 break
             detail.setdefault("relay_recovered_after", []).append(name)
 
+    if "data-io" not in done:
+        detail["phases"].append(_data_io_safe())
     detail["phases"].append(_large_projection())
     _write_detail_guarded(detail)
 
@@ -1158,6 +1251,11 @@ def main() -> None:
             summary[ph] = {
                 "achieved_tflops": res["achieved_tflops"],
                 "mxu_efficiency": res["mxu_efficiency"],
+            }
+        elif ph == "data-io":
+            summary[ph] = {
+                "native_speedup": res.get("native_speedup"),
+                "parse_py_mb_s": res.get("parse_py_mb_s"),
             }
     print(json.dumps({**headline, "suite": summary}), flush=True)
 

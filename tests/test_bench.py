@@ -85,6 +85,14 @@ class TestOrchestrator:
                   phase_results, budget="3000"):
         monkeypatch.setattr(bench, "_probe_platform", lambda *a, **k: "tpu")
         monkeypatch.setattr(bench, "_tpu_probe_ok", lambda *a, **k: True)
+        # keep the stubbed control-flow tests hermetic: the in-parent
+        # host-side phase writes real tempfiles and builds the C++ engine
+        monkeypatch.setattr(
+            bench, "_data_io_safe",
+            lambda: {"phase": "data-io", "host_side": True,
+                     "native_speedup": 3.4, "parse_py_mb_s": 60.0,
+                     "platform": "host"},
+        )
         # pin the baseline chain: the real repo grows BENCH_r*.json TPU
         # records across rounds, and vs_baseline must stay test-controlled
         monkeypatch.setattr(bench, "_prior_round_value", lambda: None)
@@ -133,10 +141,11 @@ class TestOrchestrator:
         assert final["suite"]["kernel-w256"]["fwd_speedup"] == 1.4
         detail = json.loads((tmp_path / "BENCH_DETAIL.json").read_text())
         assert detail["platform"] == "tpu"
-        # stubbed phases + the in-parent large projection
+        # stubbed phases + the in-parent host-side and projection studies
         assert [p["phase"] for p in detail["phases"]] == [
-            "train-tiny", "kernel-w256", "large-projection",
+            "train-tiny", "kernel-w256", "data-io", "large-projection",
         ]
+        assert final["suite"]["data-io"]["native_speedup"] == 3.4
 
     def test_non_tpu_phase_result_recorded_as_error(self, bench,
                                                     monkeypatch, tmp_path,
@@ -200,6 +209,11 @@ class TestResume:
         monkeypatch.setattr(bench, "_tpu_probe_ok", lambda *a, **k: True)
         monkeypatch.setattr(bench, "_prior_round_value", lambda: None)
         monkeypatch.setattr(bench, "_DETAIL_PATH", detail_path)
+        monkeypatch.setattr(
+            bench, "_data_io_safe",
+            lambda: {"phase": "data-io", "host_side": True,
+                     "native_speedup": 3.4, "platform": "host"},
+        )
         kern = {"phase": "kernel-w256", "fwd_speedup": 1.9,
                 "bwd_speedup": 1.1, "fwd_ms": {}, "bwd_ms": {},
                 "platform": "tpu"}
@@ -235,7 +249,7 @@ class TestResume:
         assert "relay_died_after" not in detail
         phases = [p["phase"] for p in detail["phases"]]
         assert phases == ["train-tiny", "kernel-w256", "kernel-w512",
-                          "large-projection"]
+                          "data-io", "large-projection"]
         assert all("error" not in p for p in detail["phases"])
         w512 = [p for p in detail["phases"] if p["phase"] == "kernel-w512"]
         assert w512[0]["fwd_speedup"] == 2.0  # fresh, not the suspect 9.0
