@@ -145,6 +145,10 @@ _PHASES = (
     ("train-tiny-scan", 720),  # XLA twin of train-tiny-pallas's structure
     ("kernel-w256", 420),
     ("kernel-w512", 420),
+    # long8k-shape kernel row (w=512 n=8192 bh=16): runs BEFORE the long8k
+    # train phases so their policy lookup is backed by a measurement at the
+    # shape they actually run, writing ops/pallas_policy.json on a clean run
+    ("kernel-w512-n8192", 600),
     ("train-default", 600),
     ("train-base", 720),
     ("train-long8k-xla", 1080),
@@ -374,6 +378,15 @@ def _train_bench(config_name: str, *, use_pallas=None, recipe=None,
             }
     except Exception as e:  # diagnostic only: never fail a timed phase
         _mark(f"cost_analysis unavailable: {e!r}")
+    # which measured kernel combo this config's attention actually traced
+    # under (ADVICE r3: make the silently-applied policy visible per phase)
+    attn_policy = None
+    if config.use_pallas_attn:
+        from progen_tpu.ops.pallas_attention import policy_decision
+
+        attn_policy = policy_decision(
+            config.window_size, n=config.seq_len, bh=micro_bs * config.heads
+        )
     return {
         "phase": f"train-{config_name}"
         + ("-pallas" if use_pallas else "-xla" if use_pallas is False else "")
@@ -390,6 +403,7 @@ def _train_bench(config_name: str, *, use_pallas=None, recipe=None,
         "scan_layers": config.scan_layers,
         "loss": round(loss_val, 4),
         "chips": n_chips,
+        **({"attn_policy": attn_policy} if attn_policy else {}),
         **({"xla_cost": xla_cost} if xla_cost else {}),
         **_suspect_fields(per_chip_flops, 1.0, peak),  # per_chip_flops is /s
         **_hbm_stats(),
@@ -397,20 +411,32 @@ def _train_bench(config_name: str, *, use_pallas=None, recipe=None,
     }
 
 
-def _kernel_bench(window: int) -> dict:
+def _kernel_bench(window: int, n: int = 1024) -> dict:
     """Pallas windowed-attention kernel vs the XLA path, fwd+bwd, at the
     flagship shapes. On TPU the kernel is Mosaic-COMPILED (interpret only
     off-TPU) and the on-chip error vs the XLA golden is recorded — the
-    non-interpret correctness evidence VERDICT round-2 asked for."""
+    non-interpret correctness evidence VERDICT round-2 asked for.
+
+    A clean on-chip run WRITES its winners into the measured policy table
+    (ops/pallas_policy.json, record_policy_entry) keyed by the measured
+    (window, n, batch*heads) — so `use_pallas_attn` configs downstream in
+    the same suite (train-long8k runs AFTER kernel-w512-n8192) pick their
+    impls from evidence at their own shapes, not an extrapolation."""
     import jax
     import jax.numpy as jnp
 
     from progen_tpu.ops.attention import local_attention
     from progen_tpu.ops.pallas_attention import pallas_local_attention
 
+    # phase label = the SCHEDULED name (requested shape), so resume
+    # bookkeeping matches even when the off-TPU smoke shrinks the shapes
+    phase_name = f"kernel-w{window}" + (f"-n{n}" if n != 1024 else "")
     on_tpu = _is_tpu_platform(jax.devices()[0].platform)
     if on_tpu:
-        b, h, n, d = 16, 8, 1024, 64
+        # n=1024: the tiny/default train shapes (bh=128). n=8192: the
+        # long8k shapes — batch shrinks to the long8k recipe's micro-batch
+        # so bh matches what the train step actually runs (bh=16).
+        b, h, d = (16, 8, 64) if n <= 2048 else (2, 8, 64)
         iters_f, iters_b = 20, 10
         w = window
     else:
@@ -511,8 +537,60 @@ def _kernel_bench(window: int) -> dict:
     t_pf_best = min([t_pf] + [v["ms"] / 1e3 for v in fwd_ms_g.values()])
     fwd_guard = _suspect_fields(fwd_flops, min(t_xf, t_pf_best), peak)
     bwd_guard = _suspect_fields(bwd_flops, min(t_xb, *t_pb.values()), peak)
+    suspect = fwd_guard["timing_suspect"] or bwd_guard["timing_suspect"]
+
+    # winner selection prices DEPLOYED COMBOS, not raw per-direction rows:
+    # every grad timing above is a full fwd+bwd pipeline (t_xb = plain XLA
+    # autodiff; t_pb[impl] = pallas-g1 fwd + that pallas bwd), so the
+    # pallas backwards' own cost is t_pb[impl] - t_pf, while a bwd="xla"
+    # escape hatch deployed under the custom VJP re-runs the whole XLA
+    # forward inside the backward (~t_xb, not t_xb - t_xf). fwd="xla" +
+    # bwd="xla" is expressed as plain local_attention by the model dispatch
+    # (no custom-VJP recompute), priced at t_xb.
+    fwd_cands = {"xla": t_xf, "pallas_g1": t_pf,
+                 # fwd_ms_g keys are already "pallas_g<N>"
+                 **{k: v["ms"] / 1e3 for k, v in fwd_ms_g.items()}}
+    best_fwd_key = min(fwd_cands, key=fwd_cands.get)
+    bwd_only = {impl: max(t - t_pf, 1e-9) for impl, t in t_pb.items()}
+    best_pl_bwd = min(bwd_only, key=bwd_only.get)
+    if best_fwd_key == "xla":
+        if t_xb <= t_xf + bwd_only[best_pl_bwd]:
+            fwd_win, bwd_win = "xla", "xla"  # plain path beats any mix
+        else:
+            fwd_win, bwd_win = "xla", best_pl_bwd
+    else:
+        # pallas fwd; an xla backward would cost a full t_xb (recomputes
+        # its own forward under the custom VJP)
+        fwd_win = "pallas"
+        bwd_win = "xla" if t_xb < bwd_only[best_pl_bwd] else best_pl_bwd
+    policy_entry = {
+        "window": w, "n": n, "bh": b * h,
+        "fwd": fwd_win,
+        "bwd": bwd_win,  # "xla" / "kv" / "halo" / "kv_g<N>"
+        "bh_block": (1 if best_fwd_key in ("xla", "pallas_g1")
+                     else int(best_fwd_key.rsplit("_g", 1)[1])),
+    }
+    # never adopt a fast-but-WRONG kernel: the policy only learns from
+    # runs whose on-chip error vs the XLA golden is within bf16 tolerance
+    # (r3b honest runs measured 2.0e-3 fwd / 1.6e-2 bwd)
+    max_bwd_err = max(bwd_err.values()) if bwd_err else 0.0
+    numerics_ok = fwd_err <= 1e-2 and max_bwd_err <= 5e-2
+    policy_recorded = False
+    if on_tpu and not suspect and numerics_ok:
+        from progen_tpu.ops.pallas_attention import record_policy_entry
+
+        record_policy_entry({
+            **policy_entry,
+            "fwd_ms": {k: round(v * 1e3, 3) for k, v in fwd_cands.items()},
+            "bwd_ms": {"xla_full": round(t_xb * 1e3, 3),
+                       **{k: round(v * 1e3, 3)
+                          for k, v in bwd_only.items()}},
+            "source": f"bench {phase_name}"
+                      + time.strftime(" %Y-%m-%d", time.gmtime()),
+        })
+        policy_recorded = True
     return {
-        "phase": f"kernel-w{window}",
+        "phase": phase_name,
         "fwd_ms": {
             "xla": round(t_xf * 1e3, 3),
             "pallas": round(t_pf * 1e3, 3),
@@ -531,8 +609,10 @@ def _kernel_bench(window: int) -> dict:
         "bwd_max_abs_err": bwd_err,  # per impl: a regression in the
                                      # slower one must stay visible
         "shape": f"b{b} h{h} n{n} d{d} w{w} bf16",
-        "timing_suspect": fwd_guard["timing_suspect"]
-        or bwd_guard["timing_suspect"],
+        "policy_entry": policy_entry,
+        "policy_recorded": policy_recorded,
+        "policy_numerics_ok": numerics_ok,
+        "timing_suspect": suspect,
         "implied_device_tflops": {
             "fwd_fastest": fwd_guard["implied_device_tflops"],
             "bwd_fastest": bwd_guard["implied_device_tflops"],
@@ -938,7 +1018,11 @@ def _cpu_smoke() -> dict:
 
 def run_phase(name: str) -> dict:
     if name.startswith("kernel-w"):
-        return _kernel_bench(int(name[len("kernel-w"):]))
+        # "kernel-w<W>" or "kernel-w<W>-n<N>" (long-context shape variant)
+        spec = name[len("kernel-w"):].split("-n")
+        return _kernel_bench(
+            int(spec[0]), int(spec[1]) if len(spec) > 1 else 1024
+        )
     if name == "train-tiny-pallas":
         # scan_layers: one scanned body = ~3 embedded Mosaic kernel
         # instances instead of the unrolled stack's 12+ — each is a

@@ -53,9 +53,10 @@ class ProGenConfig:
     # Use the Pallas local-attention kernel instead of the XLA reference path.
     use_pallas_attn: bool = False
     # Batch-heads per Pallas forward program (ops/pallas_attention
-    # bh_block): fatter blocks for small windows; 1 = one window per
-    # program. The kernel bench times variants on-chip — set from evidence.
-    pallas_bh_block: int = 1
+    # bh_block): fatter blocks for small windows. 0 (the default) lets the
+    # measured policy table (ops/pallas_policy.json) decide; any explicit
+    # value >= 1 — including 1 = one window per program — overrides it.
+    pallas_bh_block: int = 0
     # Use the EXPLICIT ring halo-exchange attention (parallel/ring_attention)
     # instead of letting GSPMD infer the halo collectives. Takes effect only
     # when the model is built with a mesh whose ``seq`` axis is > 1

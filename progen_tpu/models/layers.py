@@ -147,16 +147,24 @@ class LocalAttentionBlock(nn.Module):
             # shipping use_pallas_attn=true (long8k.toml) stays runnable
             # on CPU hosts (tests, smoke runs) without monkeypatching.
             # use_pallas_attn means "best measured kernel combo for this
-            # window", mixing per-direction winners (measured_impls);
-            # pallas_bh_block > 1 in the config overrides the policy's
-            # forward blocking.
+            # shape" — per-direction winners from the policy table keyed
+            # on (window, n, batch*heads); pallas_bh_block >= 1 (0 = unset)
+            # overrides the policy's forward blocking, so an explicit 1
+            # can force one-window-per-program even where the policy
+            # picked a batched forward.
             interpret = jax.default_backend() not in ("tpu", "axon")
-            fwd_impl, bwd_impl, g = measured_impls(w)
-            if c.pallas_bh_block > 1:
+            fwd_impl, bwd_impl, g = measured_impls(w, n=n, bh=b * h)
+            if c.pallas_bh_block:
                 g = c.pallas_bh_block  # explicit config beats the policy
-            out = pallas_local_attention(
-                q, k, v, w, None, interpret, bwd_impl, g, fwd_impl
-            )
+            if fwd_impl == "xla" and bwd_impl == "xla":
+                # both directions lost on-chip at this shape: plain XLA
+                # autodiff (going through the custom VJP would recompute
+                # the forward inside the backward for nothing)
+                out = local_attention(q, k, v, window_size=w)
+            else:
+                out = pallas_local_attention(
+                    q, k, v, w, None, interpret, bwd_impl, g, fwd_impl
+                )
         else:
             out = local_attention(q, k, v, window_size=w)
 

@@ -38,6 +38,9 @@ backward holds two rows' worth); at w=256 everything halves.
 from __future__ import annotations
 
 import functools
+import json
+import math
+from pathlib import Path
 
 import jax
 import jax.numpy as jnp
@@ -257,34 +260,148 @@ def _flops(bh: int, n: int, d: int, w: int, n_matmuls: int) -> pl.CostEstimate:
 
 
 def _parse_bwd_impl(bwd_impl: str) -> tuple[str, int] | None:
-    """"kv" / "halo" / "kv_g<N>" -> (base_impl, g); None if unknown.
-    The kv_g variants run the g-batched kv backward — same math, g
+    """"kv" / "halo" / "xla" / "kv_g<N>" -> (base_impl, g); None if
+    unknown. The kv_g variants run the g-batched kv backward — same math, g
     batch-heads per program (kernel-bench-selectable like the forward's
-    bh_block)."""
-    if bwd_impl in ("kv", "halo"):
+    bh_block). "xla" differentiates the XLA golden on the saved residuals
+    (for shapes where the measured policy finds neither Pallas backward
+    wins)."""
+    if bwd_impl in ("kv", "halo", "xla"):
         return bwd_impl, 1
     if bwd_impl.startswith("kv_g") and bwd_impl[4:].isdigit():
         return "kv", int(bwd_impl[4:])
     return None
 
 
-def measured_impls(window_size: int) -> tuple[str, str, int]:
-    """(fwd_impl, bwd_impl, bh_block) winners from the on-chip v5e kernel
-    bench (BENCH_DETAIL_TPU_r3b.json, honest host-fetch-fenced timings):
+# --------------------------------------------------------------------------
+# Measured kernel policy.
+#
+# pallas_policy.json is a table of on-chip-measured (fwd, bwd, bh_block)
+# winners keyed by the shape they were measured at — (window, n, batch*heads)
+# — written by bench.py's kernel phases (record_policy_entry) and read here.
+# Lookup picks the nearest measured shape in log-space with the window
+# dominating (the masked-waste/overhead crossover is a function of w first;
+# n and bh move the per-program amortization second). An exact match applies
+# the evidence directly; a non-exact match is a documented extrapolation,
+# surfaced via ``exact_shape_match`` so bench rows can record which one a
+# train phase actually ran under.
 
-      w=256: fwd XLA 3.56 ms vs Pallas 3.99 → XLA fwd;
-             bwd halo 8.79 ms vs XLA 10.71 → Pallas halo bwd (1.22x)
-      w=512: fwd Pallas g4 4.02 ms vs XLA 7.87 → Pallas fwd, bh_block=4;
-             bwd kv 10.12 ms vs XLA 10.94 → Pallas kv bwd (1.08x)
+_POLICY_PATH = Path(__file__).with_name("pallas_policy.json")
 
-    The crossover: at w>=512 the XLA dense path's masked-waste grows
-    faster than the kernel's per-program overhead amortizes, and the
-    kv backward's recompute beats the halo scratch traffic. Mixing is
-    sound because fwd and bwd are independent pallas_call/XLA programs
-    joined only through the (q, k, v) residuals."""
-    if window_size >= 512:
-        return "pallas", "kv", 4
-    return "xla", "halo", 1
+# The round-3 on-chip v5e measurements (BENCH_DETAIL_TPU_r3b.json, honest
+# host-fetch-fenced timings) — the built-in fallback when the JSON table is
+# absent or unreadable:
+#   w=256 @ n1024 bh128: fwd XLA 3.56 ms vs Pallas 3.99 → XLA fwd;
+#          bwd halo 8.79 ms vs XLA 10.71 → Pallas halo bwd (1.22x)
+#   w=512 @ n1024 bh128: fwd Pallas g4 4.02 vs XLA 7.87 → Pallas fwd g4;
+#          bwd kv 10.12 ms vs XLA 10.94 → Pallas kv bwd (1.08x)
+# The crossover: at w>=512 the XLA dense path's masked-waste grows faster
+# than the kernel's per-program overhead amortizes, and the kv backward's
+# recompute beats the halo scratch traffic. Mixing per-direction winners is
+# sound because fwd and bwd are independent pallas_call/XLA programs joined
+# only through the (q, k, v) residuals.
+_FALLBACK_ENTRIES = (
+    {"window": 256, "n": 1024, "bh": 128,
+     "fwd": "xla", "bwd": "halo", "bh_block": 1},
+    {"window": 512, "n": 1024, "bh": 128,
+     "fwd": "pallas", "bwd": "kv", "bh_block": 4},
+)
+
+_ENTRY_KEYS = ("window", "n", "bh", "fwd", "bwd", "bh_block")
+
+
+def _policy_entries(path: Path | None = None) -> list[dict]:
+    path = path or _POLICY_PATH
+    def _valid(e: dict) -> bool:
+        try:
+            return (
+                all(k in e for k in _ENTRY_KEYS)
+                and all(
+                    isinstance(e[k], (int, float)) and e[k] > 0
+                    for k in ("window", "n", "bh")
+                )
+                and isinstance(e["bh_block"], int) and e["bh_block"] >= 1
+                and e["fwd"] in ("pallas", "xla")
+                and _parse_bwd_impl(e["bwd"]) is not None
+            )
+        except TypeError:
+            return False
+
+    try:
+        doc = json.loads(path.read_text())
+        entries = [e for e in doc.get("entries", []) if _valid(e)]
+        if entries:
+            return entries
+    except (OSError, ValueError):
+        pass
+    return list(_FALLBACK_ENTRIES)
+
+
+def policy_decision(
+    window_size: int, n: int | None = None, bh: int | None = None,
+    path: Path | None = None,
+) -> dict:
+    """The measured-winner entry nearest to (window, n, bh), annotated with
+    ``exact_shape_match`` and the requested shape. ``n``/``bh`` omitted
+    match any measured value at that window (nearest by window alone)."""
+    entries = _policy_entries(path)
+
+    def dist(e: dict) -> float:
+        d = 4.0 * abs(math.log2(window_size / e["window"]))
+        if n:
+            d += abs(math.log2(n / e["n"]))
+        if bh:
+            d += 0.5 * abs(math.log2(bh / e["bh"]))
+        return d
+
+    best = min(entries, key=dist)
+    exact = (
+        best["window"] == window_size
+        and (n is None or best["n"] == n)
+        and (bh is None or best["bh"] == bh)
+    )
+    return {
+        **best,
+        "exact_shape_match": exact,
+        "requested": {"window": window_size, "n": n, "bh": bh},
+    }
+
+
+def measured_impls(
+    window_size: int, n: int | None = None, bh: int | None = None
+) -> tuple[str, str, int]:
+    """(fwd_impl, bwd_impl, bh_block) from the measured policy table for
+    the given shape (nearest measured shape when not an exact match — see
+    policy_decision)."""
+    e = policy_decision(window_size, n, bh)
+    return e["fwd"], e["bwd"], e["bh_block"]
+
+
+def record_policy_entry(entry: dict, path: Path | None = None) -> None:
+    """Merge one measured winner into the policy table (bench.py's kernel
+    phases call this after an on-chip, non-suspect run; keyed by the
+    measured (window, n, bh) so re-measurement replaces, never duplicates).
+    Extra keys (timings, provenance) are stored verbatim."""
+    missing = [k for k in _ENTRY_KEYS if k not in entry]
+    if missing:
+        raise ValueError(f"policy entry missing keys {missing}")
+    path = path or _POLICY_PATH
+    try:
+        doc = json.loads(path.read_text())
+        assert isinstance(doc.get("entries"), list)
+    except (OSError, ValueError, AssertionError):
+        doc = {"schema": "pallas-policy-v1", "entries": []}
+    key = lambda e: (e["window"], e["n"], e["bh"])
+    # drop malformed/legacy rows rather than KeyError after the bench has
+    # already spent its chip time — read-side tolerates them the same way
+    kept = [
+        e for e in doc["entries"]
+        if all(k in e for k in ("window", "n", "bh")) and key(e) != key(entry)
+    ]
+    doc["entries"] = sorted(kept + [entry], key=key)
+    tmp = path.with_suffix(".json.tmp")
+    tmp.write_text(json.dumps(doc, indent=1))
+    tmp.replace(path)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
@@ -387,6 +504,20 @@ def _bwd_rule(window_size, scale, interpret, bwd_impl, bh_block, fwd_impl,
     if parsed is None:
         raise ValueError(f"unknown bwd_impl {bwd_impl!r}")
     base_impl, g_req = parsed
+
+    if base_impl == "xla":
+        # differentiate the XLA golden from the same (q, k, v) residuals —
+        # the policy's escape hatch for shapes where both Pallas backwards
+        # lose on-chip (fwd_impl stays independently selectable)
+        from progen_tpu.ops.attention import local_attention
+
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: local_attention(
+                q_, k_, v_, window_size=w, scale=scale
+            ),
+            q, k, v,
+        )
+        return vjp(g)
 
     if base_impl == "kv":
         g_bwd = _safe_bh_block(g_req, bh, w, n_probs=2)

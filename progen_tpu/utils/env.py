@@ -41,6 +41,10 @@ def load_env_file(path: str = ".env") -> dict:
             value = value.strip(value[0])
         else:  # unquoted: dotenv strips trailing inline comments
             value = value.split(" #", 1)[0].split("\t#", 1)[0].strip()
+        # ${DOTENV_DIR} expands to the directory holding this .env file, so
+        # a committed .env can point at repo-relative paths (e.g. the XLA
+        # compilation cache) without baking in one machine's checkout path
+        value = value.replace("${DOTENV_DIR}", str(p.parent.resolve()))
         parsed[key] = value
         os.environ.setdefault(key, value)
     return parsed

@@ -34,6 +34,19 @@ class TestLoader:
     def test_missing_file(self):
         assert load_env_file("/nonexistent/.env") == {}
 
+    def test_dotenv_dir_expansion(self, tmp_path):
+        # ${DOTENV_DIR} -> the .env file's own directory, keeping committed
+        # repo-relative paths (XLA cache dir) checkout-path-agnostic
+        f = tmp_path / ".env"
+        f.write_text("CACHE=${DOTENV_DIR}/runs/xla_cache\n")
+        saved = dict(os.environ)
+        try:
+            parsed = load_env_file(str(f))
+        finally:
+            os.environ.clear()
+            os.environ.update(saved)
+        assert parsed["CACHE"] == str(tmp_path.resolve() / "runs/xla_cache")
+
     def test_upward_search(self, tmp_path, monkeypatch):
         (tmp_path / ".env").write_text("UPWARD_FOUND=yes\n")
         sub = tmp_path / "a" / "b"
@@ -66,4 +79,9 @@ class TestShippedDefaultEnv:
         assert "xla_tpu" not in parsed.get("XLA_FLAGS", "")
         assert "--xla_tpu_enable_async_collective_fusion" in parsed.get(
             "LIBTPU_INIT_ARGS", ""
+        )
+        # the shipped cache dir must resolve under THIS checkout, not a
+        # hardcoded absolute path from someone else's machine
+        assert parsed["JAX_COMPILATION_CACHE_DIR"] == str(
+            REPO_ROOT / "runs/xla_cache"
         )
