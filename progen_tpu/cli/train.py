@@ -89,6 +89,19 @@ def confirm(question: str) -> bool:
               help="ZeRO-1: shard the AdamW moments over the data mesh axis "
                    "(1/data-size the optimizer memory; forward/backward "
                    "layout unchanged)")
+@click.option("--mesh_pipe", default=0,
+              help="GPipe pipeline stages over the model mesh axis (the "
+                   "depth-sharded path when the layer stack outgrows one "
+                   "chip even after TP; repurposes the model axis, so "
+                   "mutually exclusive with --mesh_model > 1). Requires "
+                   "scan_layers=true in the model TOML. NOTE: backward is "
+                   "the GPipe autodiff transpose — O(microbatches) "
+                   "activation memory; pair with remat=true")
+@click.option("--pipe_microbatches", default=0,
+              help="GPipe microbatches per micro-step (0 = same as "
+                   "--mesh_pipe); bubble fraction = (P-1)/(M+P-1), so "
+                   "larger M amortizes the bubble at the cost of "
+                   "activation memory")
 def main(
     seed,
     batch_size,
@@ -125,6 +138,8 @@ def main(
     ring_attn,
     async_checkpoint,
     zero1,
+    mesh_pipe,
+    pipe_microbatches,
 ):
     from progen_tpu.checkpoint import Package, get_checkpoint_fns
     from progen_tpu.config import ProGenConfig, load_toml_config
@@ -222,6 +237,40 @@ def main(
         "shuffle_seed": shuffle_seed,
     }
 
+    # --- pipeline stages ride the model mesh axis (parallel/pipeline.py)
+    pipe_m = 0
+    if mesh_pipe > 1:
+        if mesh_model > 1:
+            raise click.UsageError(
+                "--mesh_pipe repurposes the model mesh axis as the stage "
+                "axis; it is mutually exclusive with --mesh_model > 1"
+            )
+        if ring_attn:
+            raise click.UsageError(
+                "--mesh_pipe and --ring_attn are separate deployment "
+                "paths (stages run inside shard_map; the ring rides the "
+                "seq axis of the GSPMD step)"
+            )
+        if not config.scan_layers:
+            raise click.UsageError(
+                "--mesh_pipe needs scan_layers=true in the model TOML: "
+                "the stacked 'layers' param axis IS the stage axis "
+                "(models/progen.stack_params converts old checkpoints)"
+            )
+        n_uniform = config.depth - config.global_mlp_depth
+        if n_uniform % mesh_pipe:
+            raise click.UsageError(
+                f"{n_uniform} uniform layers not divisible by "
+                f"{mesh_pipe} pipeline stages"
+            )
+        pipe_m = pipe_microbatches or mesh_pipe
+        if batch_size % pipe_m:
+            raise click.UsageError(
+                f"--batch_size {batch_size} not divisible by "
+                f"{pipe_m} pipeline microbatches"
+            )
+        mesh_model = mesh_pipe
+
     # --- mesh: data_parallel -> absorb all devices on the data axis
     if mesh_data == 0:
         mesh_data = -1 if (data_parallel or mesh_seq * mesh_model > 1) else 1
@@ -242,12 +291,17 @@ def main(
     else:
         model = ProGen(config)
 
-    # --- state: cold init or sharded restore (never both)
+    # --- state: cold init or sharded restore (never both). Pipeline mode
+    # lays the state out by PIPELINE_RULES (stacked layer axis = stages;
+    # TP rules off) — same checkpoint format either way, only placement.
+    from progen_tpu.parallel.partition import DEFAULT_RULES, PIPELINE_RULES
+
+    rules = PIPELINE_RULES if mesh_pipe > 1 else DEFAULT_RULES
     start_seq_index, run_id = 0, None
     if last_meta is None:
         state, shardings = init_train_state(
             model, optimizer, jax.random.PRNGKey(seed), config.seq_len,
-            mesh=mesh, zero1=zero1,
+            mesh=mesh, rules=rules, zero1=zero1,
         )
     else:
         from progen_tpu.checkpoint import sharded_abstract_state
@@ -255,7 +309,7 @@ def main(
         boxed, abstract = abstract_train_state(
             model, optimizer, config.seq_len
         )
-        shardings = train_state_shardings(boxed, mesh, zero1=zero1)
+        shardings = train_state_shardings(boxed, mesh, rules, zero1=zero1)
         pkg = get_last(sharded_abstract_state(abstract, shardings))
         state = pkg.state
         start_seq_index = pkg.next_seq_index
@@ -349,10 +403,22 @@ def main(
       with mesh:
         # compiled steps live INSIDE the try: a jit failure here must
         # still run the finally that stops the loop=True prefetch workers
-        train_step = compile_train_step(
-            model, optimizer, state, shardings, mesh
-        )
-        eval_step = compile_eval_step(model, shardings, mesh)
+        if mesh_pipe > 1:
+            from progen_tpu.parallel.pipeline import (
+                compile_pipeline_train_step,
+            )
+
+            train_step = compile_pipeline_train_step(
+                model, optimizer, shardings, mesh, n_microbatches=pipe_m
+            )
+            # rules=(): GSPMD activation constraints are meaningless when
+            # the model axis holds stages, and the step runs without them
+            eval_step = compile_eval_step(model, shardings, mesh, rules=())
+        else:
+            train_step = compile_train_step(
+                model, optimizer, state, shardings, mesh
+            )
+            eval_step = compile_eval_step(model, shardings, mesh)
         # pre-fetch only when the loop will actually run: resuming a
         # completed run (empty seq_indices) must fall through, not block
         # on a skip-exhausted iterator

@@ -71,6 +71,27 @@ DEFAULT_RULES = (
     ("mlp_act", "model"),
 )
 
+# GPipe deployment (parallel/pipeline.py): the ``model`` mesh axis holds
+# PIPELINE STAGES, so the scan_layers stacked axis shards over it and every
+# tensor-parallel rule is off (a dimension cannot be both a stage index and
+# a TP shard; stages run inside shard_map where GSPMD constraints are inert
+# anyway). Used for STATE layout (init / restore / jit in-out shardings);
+# the step itself runs with rules=().
+PIPELINE_RULES = (
+    ("layers", "model"),
+    ("vocab", None),
+    ("embed", None),
+    ("qkv", None),
+    ("mlp", None),
+    ("sgu_hidden", None),
+    ("sgu_seq_out", None),
+    ("sgu_seq_in", None),
+    ("batch", "data"),
+    ("seq_act", None),
+    ("embed_act", None),
+    ("mlp_act", None),
+)
+
 
 # device files whose presence marks a TPU VM (tests monkeypatch this)
 _TPU_DEV_PATHS = ("/dev/accel0", "/dev/vfio/0")

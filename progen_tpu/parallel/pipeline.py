@@ -229,3 +229,34 @@ def make_pipeline_train_step(
         )
 
     return make_train_step(model, optimizer, rules=(), forward_fn=forward)
+
+
+def compile_pipeline_train_step(
+    model,
+    optimizer,
+    shardings,
+    mesh: Mesh,
+    *,
+    axis: str = "model",
+    n_microbatches: int,
+):
+    """jit ``make_pipeline_train_step`` with explicit state/batch shardings
+    and a donated state — the pipeline twin of
+    ``training/step.compile_train_step``. ``shardings`` must be built with
+    ``partition.PIPELINE_RULES`` (stacked layer axis over ``axis``; TP rules
+    off). MEMORY NOTE: the backward is GPipe's autodiff transpose — all M
+    microbatches' stage activations stay live until the backward sweep
+    (O(M) activation memory, not 1F1B's O(stages)); pair with
+    ``config.remat`` when that matters."""
+    from progen_tpu.parallel.partition import batch_sharding
+
+    step = make_pipeline_train_step(
+        model, optimizer, mesh=mesh, axis=axis,
+        n_microbatches=n_microbatches,
+    )
+    return jax.jit(
+        step,
+        in_shardings=(shardings, batch_sharding(mesh, accum_axis=True)),
+        out_shardings=(shardings, None),
+        donate_argnums=(0,),
+    )

@@ -143,6 +143,81 @@ def test_combined_features_loop(workspace, monkeypatch):
     assert "loss:" in res.output
 
 
+PIPE_TOML = """num_tokens = 256
+dim = 32
+depth = 5
+heads = 2
+dim_head = 16
+window_size = 8
+seq_len = 32
+global_mlp_depth = 1
+ff_mult = 2
+dtype = "float32"
+scan_layers = true
+"""
+
+
+def test_pipeline_cli_loop(workspace, monkeypatch):
+    """--mesh_pipe: the GPipe depth-sharded train path end-to-end on the
+    8-virtual-device mesh (4 stages x 2 data), with validation, cadenced
+    sampling off the stacked params, and a flagless pipelined resume."""
+    monkeypatch.chdir(workspace)
+    runner = CliRunner()
+
+    from progen_tpu.cli.train import main as train_main
+
+    (workspace / "configs" / "model" / "pipe.toml").write_text(PIPE_TOML)
+    ckpts = workspace / "ckpts_pipe"
+    args = [
+        "--wandb_off", "--batch_size", "4", "--grad_accum_every", "1",
+        "--num_steps", "2", "--mesh_pipe", "4", "--mesh_data", "2",
+        "--pipe_microbatches", "2",
+        "--model_name", "pipe",
+        "--validate_every", "1", "--sample_every", "2",
+        "--checkpoint_every", "1000", "--seq_len", "32",
+        "--config_path", str(workspace / "configs" / "model"),
+        "--data_path", str(workspace / "train_data"),
+        "--checkpoint_path", str(ckpts),
+    ]
+    res = runner.invoke(train_main, args)
+    assert res.exit_code == 0, res.output
+    assert "loss:" in res.output and "valid_loss:" in res.output
+
+    # pipelined resume restores the sharded state into the PIPELINE_RULES
+    # layout (stacked layer axis over the stage axis)
+    res = runner.invoke(train_main, args[:5] + ["--num_steps", "1"]
+                        + args[7:])
+    assert res.exit_code == 0, res.output
+    assert "loss:" in res.output
+
+
+def test_pipeline_cli_guards(workspace, monkeypatch):
+    monkeypatch.chdir(workspace)
+    runner = CliRunner()
+
+    from progen_tpu.cli.train import main as train_main
+
+    # default.toml has no scan_layers: the stage axis needs the stacked
+    # param layout, so the flag must refuse with a pointed message
+    res = runner.invoke(train_main, [
+        "--wandb_off", "--mesh_pipe", "2",
+        "--config_path", str(workspace / "configs" / "model"),
+        "--data_path", str(workspace / "train_data"),
+        "--checkpoint_path", str(workspace / "ckpts_pipe_guard"),
+    ])
+    assert res.exit_code != 0
+    assert "scan_layers" in res.output
+
+    res = runner.invoke(train_main, [
+        "--wandb_off", "--mesh_pipe", "2", "--mesh_model", "2",
+        "--config_path", str(workspace / "configs" / "model"),
+        "--data_path", str(workspace / "train_data"),
+        "--checkpoint_path", str(workspace / "ckpts_pipe_guard"),
+    ])
+    assert res.exit_code != 0
+    assert "mutually exclusive" in res.output
+
+
 def test_eval_cli(workspace, monkeypatch):
     """Offline eval: mean per-sequence loss + perplexity over a split from
     the latest checkpoint (uses the checkpoints the train test wrote)."""
