@@ -173,6 +173,13 @@ def pipeline_forward(
         h, _ = block.apply({"params": layer_params}, h, sin, cos)
         return h
 
+    if c.remat:
+        # the backward sweep only keeps each layer's INPUT boundary and
+        # recomputes its internals — the same per-block remat the plain
+        # scan_layers path gets (models/progen.py), which is what bounds
+        # the GPipe transpose's live activations to microbatch boundaries
+        block_fn = jax.checkpoint(block_fn)
+
     x = pipeline_apply(
         block_fn,
         params["layers"],

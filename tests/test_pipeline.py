@@ -193,6 +193,33 @@ class TestPipelineForwardRealModel:
             g_pipe,
         )
 
+    def test_remat_gradient_parity(self, setup):
+        """config.remat wraps each stage layer in jax.checkpoint — the
+        GPipe transpose's memory mitigation — without changing grads."""
+        import dataclasses
+
+        from progen_tpu.models.progen import ProGen
+        from progen_tpu.parallel.pipeline import pipeline_forward
+
+        model, params, tokens, _ = setup
+        rmodel = ProGen(dataclasses.replace(model.config, remat=True))
+        mesh = make_mesh(data=1, seq=1, model=4)
+        g_ref = jax.grad(
+            lambda p: model.apply({"params": p}, tokens).sum()
+        )(params)
+        g_remat = jax.grad(
+            lambda p: pipeline_forward(
+                rmodel, p, tokens, mesh=mesh, n_microbatches=4
+            ).sum()
+        )(params)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=5e-3
+            ),
+            g_ref,
+            g_remat,
+        )
+
     def test_unrolled_layout_rejected(self, setup):
         from progen_tpu.parallel.pipeline import pipeline_forward
 
