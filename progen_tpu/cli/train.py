@@ -102,6 +102,12 @@ def confirm(question: str) -> bool:
                    "--mesh_pipe); bubble fraction = (P-1)/(M+P-1), so "
                    "larger M amortizes the bubble at the cost of "
                    "activation memory")
+@click.option("--pipe_schedule", default="gpipe",
+              type=click.Choice(["gpipe", "1f1b"]),
+              help="pipeline schedule: gpipe (autodiff transpose, "
+                   "O(microbatches) boundary activations) or 1f1b "
+                   "(interleaved fwd/bwd, O(stages) in-flight activations "
+                   "— the large-microbatch-count deployment)")
 def main(
     seed,
     batch_size,
@@ -140,6 +146,7 @@ def main(
     zero1,
     mesh_pipe,
     pipe_microbatches,
+    pipe_schedule,
 ):
     from progen_tpu.checkpoint import Package, get_checkpoint_fns
     from progen_tpu.config import ProGenConfig, load_toml_config
@@ -404,13 +411,24 @@ def main(
         # compiled steps live INSIDE the try: a jit failure here must
         # still run the finally that stops the loop=True prefetch workers
         if mesh_pipe > 1:
-            from progen_tpu.parallel.pipeline import (
-                compile_pipeline_train_step,
-            )
+            if pipe_schedule == "1f1b":
+                from progen_tpu.parallel.pipeline_1f1b import (
+                    compile_1f1b_train_step,
+                )
 
-            train_step = compile_pipeline_train_step(
-                model, optimizer, shardings, mesh, n_microbatches=pipe_m
-            )
+                train_step = compile_1f1b_train_step(
+                    model, optimizer, shardings, mesh,
+                    n_microbatches=pipe_m,
+                )
+            else:
+                from progen_tpu.parallel.pipeline import (
+                    compile_pipeline_train_step,
+                )
+
+                train_step = compile_pipeline_train_step(
+                    model, optimizer, shardings, mesh,
+                    n_microbatches=pipe_m,
+                )
             # rules=(): GSPMD activation constraints are meaningless when
             # the model axis holds stages, and the step runs without them
             eval_step = compile_eval_step(model, shardings, mesh, rules=())

@@ -133,8 +133,11 @@ class LocalAttentionBlock(nn.Module):
                 ring_local_attention,
             )
 
+            # use_pallas_attn composes: each ring shard runs the measured
+            # kernel (halo-aware variant) instead of the XLA dense path
             out = ring_local_attention(
-                q, k, v, window_size=w, mesh=self.mesh
+                q, k, v, window_size=w, mesh=self.mesh,
+                use_pallas=c.use_pallas_attn,
             )
         elif c.use_pallas_attn:
             from progen_tpu.ops.pallas_attention import (

@@ -31,6 +31,19 @@ including the window-0 zero-key softmax dilution). Design:
       plus the "previous" half of program i+1). The discarded first-half
       at program 0 is exactly the gradient of the phantom zero keys.
 
+    Additionally ``"xla"`` differentiates the XLA golden on the saved
+    residuals — the measured policy's escape hatch for shapes where both
+    Pallas backwards lose on-chip.
+
+``pallas_local_attention_halo`` is the ring-composition variant: window
+0's "previous window" comes from a sequence-parallel neighbor's halo
+(parallel/ring_attention.py) instead of the phantom zeros; its gradient
+is one tiny window-0 recompute outside the kernel (_halo_grads).
+
+Impl selection is a measured policy table (pallas_policy.json +
+measured_impls) keyed by the shapes bench.py's kernel phases actually
+timed on-chip; see the policy section below.
+
 VMEM at w=512, d=64, f32: q/k2/v2 ~0.4 MB + probs (w, 2w) 2 MB (the kv
 backward holds two rows' worth); at w=256 everything halves.
 """
@@ -56,29 +69,47 @@ def _window_mask(w: int) -> jnp.ndarray:
     return j <= i + w
 
 
-def _halo_kv(kp_ref, kc_ref, vp_ref, vc_ref, dtype):
-    """Concatenate [prev | cur] k/v, zeroing the prev halo for window 0."""
+def _prev_block(p_ref, h_ref, dtype):
+    """(g, w, d) previous-window block in f32: window 0's is the halo when
+    one is given (ring sequence-parallel shards), zeros otherwise (the
+    reference's phantom zero keys)."""
     not_first = (pl.program_id(1) > 0).astype(dtype)
-    k2 = jnp.concatenate([kp_ref[0] * not_first, kc_ref[0]], axis=0)
-    v2 = jnp.concatenate([vp_ref[0] * not_first, vc_ref[0]], axis=0)
+    prev = p_ref[...].astype(dtype) * not_first
+    if h_ref is not None:
+        prev = prev + h_ref[...].astype(dtype) * (1 - not_first)
+    return prev
+
+
+def _halo_kv(kp_ref, kc_ref, vp_ref, vc_ref, dtype, hk_ref=None,
+             hv_ref=None):
+    """Concatenate [prev | cur] k/v for ONE window program; window 0's
+    prev is the halo if given, zeros otherwise."""
+    k2 = jnp.concatenate(
+        [_prev_block(kp_ref, hk_ref, dtype)[0], kc_ref[0]], axis=0
+    )
+    v2 = jnp.concatenate(
+        [_prev_block(vp_ref, hv_ref, dtype)[0], vc_ref[0]], axis=0
+    )
     return k2, v2
 
 
-def _fwd_kernel(q_ref, kp_ref, kc_ref, vp_ref, vc_ref, o_ref, *, scale):
+def _fwd_kernel(q_ref, kp_ref, kc_ref, vp_ref, vc_ref, *rest, scale):
     """Forward over a (g, w, d) block: g batch-heads' windows per program
     (g=1 is the original one-window-per-program layout). Larger g means
     fewer, fatter programs — bigger MXU tiles and less per-program
     overhead at small w; bounded by the (g, w, 2w) f32 probabilities in
-    VMEM. The on-chip winner is chosen by the kernel bench, not assumed."""
+    VMEM. The on-chip winner is chosen by the kernel bench, not assumed.
+    ``rest`` is (o_ref,) or, in ring-halo mode, (hk_ref, hv_ref, o_ref)."""
+    hk_ref, hv_ref = (rest[0], rest[1]) if len(rest) == 3 else (None, None)
+    o_ref = rest[-1]
     w = q_ref.shape[1]
     f32 = jnp.float32
     q = q_ref[...].astype(f32)  # (g, w, d)
-    not_first = (pl.program_id(1) > 0).astype(f32)
     k2 = jnp.concatenate(
-        [kp_ref[...].astype(f32) * not_first, kc_ref[...].astype(f32)], axis=1
+        [_prev_block(kp_ref, hk_ref, f32), kc_ref[...].astype(f32)], axis=1
     )  # (g, 2w, d)
     v2 = jnp.concatenate(
-        [vp_ref[...].astype(f32) * not_first, vc_ref[...].astype(f32)], axis=1
+        [_prev_block(vp_ref, hv_ref, f32), vc_ref[...].astype(f32)], axis=1
     )
     p = _softmax_rows_batched(q, k2, w, scale)  # (g, w, 2w)
     o = jax.lax.dot_general(  # (g, w, d)
@@ -90,13 +121,15 @@ def _fwd_kernel(q_ref, kp_ref, kc_ref, vp_ref, vc_ref, o_ref, *, scale):
 
 
 def _bwd_kernel(
-    q_ref, kp_ref, kc_ref, vp_ref, vc_ref, do_ref,
-    dq_ref, dk2_ref, dv2_ref, *, scale,
+    q_ref, kp_ref, kc_ref, vp_ref, vc_ref, do_ref, *rest, scale,
 ):
+    hk_ref, hv_ref = (rest[0], rest[1]) if len(rest) == 5 else (None, None)
+    dq_ref, dk2_ref, dv2_ref = rest[-3:]
     w = q_ref.shape[1]
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    k2, v2 = _halo_kv(kp_ref, kc_ref, vp_ref, vc_ref, jnp.float32)
+    k2, v2 = _halo_kv(kp_ref, kc_ref, vp_ref, vc_ref, jnp.float32,
+                      hk_ref, hv_ref)
     p = _softmax_row(q, k2, w, scale)  # (w, 2w)
     ds = _ds_from(p, do, v2)  # softmax bwd
     # masked positions have p == 0 => ds == 0 there; no extra mask needed
@@ -168,7 +201,7 @@ def _ds_from_batched(p, do, v2):
 def _bwd_kv_kernel_batched(
     qc_ref, qn_ref, doc_ref, don_ref,
     kp_ref, kc_ref, kn_ref, vp_ref, vc_ref, vn_ref,
-    dq_ref, dk_ref, dv_ref, *, scale,
+    *rest, scale,
 ):
     """kv-centric backward over (g, w, d) blocks: program j owns k_j/v_j,
     whose only consumers are query windows j ([prev|CUR] half) and j+1
@@ -177,11 +210,15 @@ def _bwd_kv_kernel_batched(
     the one-window-per-program layout; larger g batches g batch-heads per
     program for fatter MXU tiles (the lever that wins the w=512 forward).
     VMEM cost doubles vs the forward's g blocks — two (g, w, 2w) f32
-    probability tensors live at once — so _safe_bh_block gets n_probs=2."""
+    probability tensors live at once — so _safe_bh_block gets n_probs=2.
+    ``rest`` is (dq, dk, dv) refs or, in ring-halo mode,
+    (hk, hv, dq, dk, dv) — the halo only changes row 0's recompute; its
+    own gradient is produced outside the kernel (see _bwd_rule)."""
+    hk_ref, hv_ref = (rest[0], rest[1]) if len(rest) == 5 else (None, None)
+    dq_ref, dk_ref, dv_ref = rest[-3:]
     w = qc_ref.shape[1]
     f32 = jnp.float32
     j = pl.program_id(1)
-    not_first = (j > 0).astype(f32)
     has_next = (j < pl.num_programs(1) - 1).astype(f32)
 
     qc = qc_ref[...].astype(f32)  # (g, w, d)
@@ -189,9 +226,9 @@ def _bwd_kv_kernel_batched(
     kc = kc_ref[...].astype(f32)
     vc = vc_ref[...].astype(f32)
 
-    # row j: k2 = [k_{j-1} | k_j], zeroed at j == 0
-    k2 = jnp.concatenate([kp_ref[...].astype(f32) * not_first, kc], axis=1)
-    v2 = jnp.concatenate([vp_ref[...].astype(f32) * not_first, vc], axis=1)
+    # row j: k2 = [k_{j-1} | k_j]; j == 0's prev is halo-or-zeros
+    k2 = jnp.concatenate([_prev_block(kp_ref, hk_ref, f32), kc], axis=1)
+    v2 = jnp.concatenate([_prev_block(vp_ref, hv_ref, f32), vc], axis=1)
     p = _softmax_rows_batched(qc, k2, w, scale)
     ds = _ds_from_batched(p, doc, v2)
 
@@ -449,8 +486,26 @@ def _safe_bh_block(bh_block: int, bh: int, w: int, n_probs: int = 1) -> int:
     return g
 
 
+
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct carrying ``like``'s varying-mesh-axes type (vma):
+    under jax 0.9's shard_map check_vma, pallas_call outputs must declare
+    which manual axes they vary over — inherit it from an input, which is
+    frozenset() outside shard_map (a no-op there)."""
+    return jax.ShapeDtypeStruct(
+        shape, dtype, vma=getattr(jax.typeof(like), "vma", None)
+    )
+
+def _halo_spec(w: int, d: int, g: int):
+    """BlockSpec for a (bh, w, d) halo array: every program reads its own
+    batch-heads' halo block (only window 0 uses it in-kernel)."""
+    return pl.BlockSpec(
+        (g, w, d), lambda b_, i: (b_, 0, 0), memory_space=pltpu.VMEM
+    )
+
+
 def _fwd(q, k, v, window_size, scale, interpret, bh_block=1,
-         fwd_impl="pallas"):
+         fwd_impl="pallas", halo_k=None, halo_v=None):
     b, h, n, d = q.shape
     w = window_size
     if n % w != 0:
@@ -464,23 +519,31 @@ def _fwd(q, k, v, window_size, scale, interpret, bh_block=1,
         # to the pure-Pallas path (flash-style recompute either way)
         from progen_tpu.ops.attention import local_attention
 
-        out = local_attention(q, k, v, window_size=w, scale=scale)
+        out = local_attention(
+            q, k, v, window_size=w, scale=scale,
+            first_prev_k=halo_k, first_prev_v=halo_v,
+        )
         return out, (q, k, v)
     bh, nw = b * h, n // w
     g = _safe_bh_block(bh_block, bh, w)
     qf, kf, vf = (t.reshape(bh, n, d) for t in (q, k, v))
 
     cur, prev, spec = _index_maps(w, d, g)
+    in_specs = [spec(cur), spec(prev), spec(cur), spec(prev), spec(cur)]
+    operands = [qf, kf, kf, vf, vf]
+    if halo_k is not None:
+        in_specs += [_halo_spec(w, d, g)] * 2
+        operands += [halo_k.reshape(bh, w, d), halo_v.reshape(bh, w, d)]
     out = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale),
         grid=(bh // g, nw),
-        in_specs=[spec(cur), spec(prev), spec(cur), spec(prev), spec(cur)],
+        in_specs=in_specs,
         out_specs=spec(cur),
-        out_shape=jax.ShapeDtypeStruct((bh, n, d), q.dtype),
+        out_shape=_sds((bh, n, d), q.dtype, qf),
         cost_estimate=_flops(bh, n, d, w, 2),
         compiler_params=_PARALLEL_GRID,
         interpret=interpret,
-    )(qf, kf, kf, vf, vf)
+    )(*operands)
     return out.reshape(b, h, n, d), (q, k, v)
 
 
@@ -489,8 +552,34 @@ def _fwd_rule(q, k, v, window_size, scale, interpret, bwd_impl, bh_block,
     return _fwd(q, k, v, window_size, scale, interpret, bh_block, fwd_impl)
 
 
-def _bwd_rule(window_size, scale, interpret, bwd_impl, bh_block, fwd_impl,
-              residuals, g):
+def _halo_grads(qf, kf, vf, gf, halo_k, halo_v, w, d, scale, shape):
+    """d(halo_k), d(halo_v): only window 0's row touches the halo, so its
+    gradient is one tiny (bh, w, 2w) recompute in plain XLA — both Pallas
+    backwards deliberately exclude the prev-half of row 0 from dk/dv (for
+    zero halos those keys are constants), so nothing double-counts."""
+    b, h, _, _ = shape
+    bh = b * h
+    f32 = jnp.float32
+    hk = halo_k.reshape(bh, w, d).astype(f32)
+    hv = halo_v.reshape(bh, w, d).astype(f32)
+    q0 = qf[:, :w].astype(f32)
+    do0 = gf[:, :w].astype(f32)
+    k2_0 = jnp.concatenate([hk, kf[:, :w].astype(f32)], axis=1)
+    v2_0 = jnp.concatenate([hv, vf[:, :w].astype(f32)], axis=1)
+    p0 = _softmax_rows_batched(q0, k2_0, w, scale)
+    ds0 = _ds_from_batched(p0, do0, v2_0)
+    tq = lambda a, b_: jax.lax.dot_general(
+        a, b_,
+        dimension_numbers=(((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=f32,
+    )
+    d_hk = (tq(ds0[:, :, :w], q0) * scale).astype(halo_k.dtype)
+    d_hv = tq(p0[:, :, :w], do0).astype(halo_v.dtype)
+    return d_hk.reshape(b, h, w, d), d_hv.reshape(b, h, w, d)
+
+
+def _bwd_core(window_size, scale, interpret, bwd_impl, bh_block, fwd_impl,
+              residuals, g, halo_k=None, halo_v=None):
     q, k, v = residuals
     b, h, n, d = q.shape
     w = window_size
@@ -499,6 +588,11 @@ def _bwd_rule(window_size, scale, interpret, bwd_impl, bh_block, fwd_impl,
     bh, nw = b * h, n // w
     qf, kf, vf = (t.reshape(bh, n, d) for t in (q, k, v))
     gf = g.reshape(bh, n, d)
+    with_halo = halo_k is not None
+    halo_ops = (
+        [halo_k.reshape(bh, w, d), halo_v.reshape(bh, w, d)]
+        if with_halo else []
+    )
 
     parsed = _parse_bwd_impl(bwd_impl)
     if parsed is None:
@@ -506,11 +600,20 @@ def _bwd_rule(window_size, scale, interpret, bwd_impl, bh_block, fwd_impl,
     base_impl, g_req = parsed
 
     if base_impl == "xla":
-        # differentiate the XLA golden from the same (q, k, v) residuals —
-        # the policy's escape hatch for shapes where both Pallas backwards
+        # differentiate the XLA golden from the same residuals — the
+        # policy's escape hatch for shapes where both Pallas backwards
         # lose on-chip (fwd_impl stays independently selectable)
         from progen_tpu.ops.attention import local_attention
 
+        if with_halo:
+            _, vjp = jax.vjp(
+                lambda q_, k_, v_, hk_, hv_: local_attention(
+                    q_, k_, v_, window_size=w, scale=scale,
+                    first_prev_k=hk_, first_prev_v=hv_,
+                ),
+                q, k, v, halo_k, halo_v,
+            )
+            return vjp(g)
         _, vjp = jax.vjp(
             lambda q_, k_, v_: local_attention(
                 q_, k_, v_, window_size=w, scale=scale
@@ -523,39 +626,49 @@ def _bwd_rule(window_size, scale, interpret, bwd_impl, bh_block, fwd_impl,
         g_bwd = _safe_bh_block(g_req, bh, w, n_probs=2)
         cur, prev, spec = _index_maps(w, d, g_bwd)
         nxt = lambda b_, i: (b_, jnp.minimum(i + 1, nw - 1), 0)
+        in_specs = [
+            spec(cur), spec(nxt),              # q_j, q_{j+1}
+            spec(cur), spec(nxt),              # do_j, do_{j+1}
+            spec(prev), spec(cur), spec(nxt),  # k_{j-1}, k_j, k_{j+1}
+            spec(prev), spec(cur), spec(nxt),  # v_{j-1}, v_j, v_{j+1}
+        ]
+        if with_halo:
+            in_specs += [_halo_spec(w, d, g_bwd)] * 2
         dq, dk, dv = pl.pallas_call(
             functools.partial(_bwd_kv_kernel_batched, scale=scale),
             grid=(bh // g_bwd, nw),
-            in_specs=[
-                spec(cur), spec(nxt),              # q_j, q_{j+1}
-                spec(cur), spec(nxt),              # do_j, do_{j+1}
-                spec(prev), spec(cur), spec(nxt),  # k_{j-1}, k_j, k_{j+1}
-                spec(prev), spec(cur), spec(nxt),  # v_{j-1}, v_j, v_{j+1}
-            ],
+            in_specs=in_specs,
             out_specs=[spec(cur)] * 3,
             out_shape=[
-                jax.ShapeDtypeStruct((bh, n, d), q.dtype),
-                jax.ShapeDtypeStruct((bh, n, d), k.dtype),
-                jax.ShapeDtypeStruct((bh, n, d), v.dtype),
+                _sds((bh, n, d), q.dtype, qf),
+                _sds((bh, n, d), k.dtype, qf),
+                _sds((bh, n, d), v.dtype, qf),
             ],
             cost_estimate=_flops(bh, n, d, w, 8),
             compiler_params=_PARALLEL_GRID,
             interpret=interpret,
-        )(qf, qf, gf, gf, kf, kf, kf, vf, vf, vf)
-        return tuple(t.reshape(b, h, n, d) for t in (dq, dk, dv))
+        )(qf, qf, gf, gf, kf, kf, kf, vf, vf, vf, *halo_ops)
+        out = tuple(t.reshape(b, h, n, d) for t in (dq, dk, dv))
+        if with_halo:
+            return out + _halo_grads(
+                qf, kf, vf, gf, halo_k, halo_v, w, d, scale, q.shape
+            )
+        return out
 
     halo_block = pl.BlockSpec(
         (1, 1, 2 * w, d), lambda b_, i: (b_, i, 0, 0), memory_space=pltpu.VMEM
     )
+    in_specs = _specs(w, d) + [
+        pl.BlockSpec(
+            (1, w, d), lambda b_, i: (b_, i, 0), memory_space=pltpu.VMEM
+        )
+    ]
+    if with_halo:
+        in_specs += [_halo_spec(w, d, 1)] * 2
     dq, dk2, dv2 = pl.pallas_call(
         functools.partial(_bwd_kernel, scale=scale),
         grid=(bh, nw),
-        in_specs=_specs(w, d)
-        + [
-            pl.BlockSpec(
-                (1, w, d), lambda b_, i: (b_, i, 0), memory_space=pltpu.VMEM
-            )
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec(
                 (1, w, d), lambda b_, i: (b_, i, 0), memory_space=pltpu.VMEM
@@ -564,26 +677,85 @@ def _bwd_rule(window_size, scale, interpret, bwd_impl, bh_block, fwd_impl,
             halo_block,
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, n, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, nw, 2 * w, d), jnp.float32),
-            jax.ShapeDtypeStruct((bh, nw, 2 * w, d), jnp.float32),
+            _sds((bh, n, d), q.dtype, qf),
+            _sds((bh, nw, 2 * w, d), jnp.float32, qf),
+            _sds((bh, nw, 2 * w, d), jnp.float32, qf),
         ],
         cost_estimate=_flops(bh, n, d, w, 5),
         compiler_params=_PARALLEL_GRID,
         interpret=interpret,
-    )(qf, kf, kf, vf, vf, gf)
+    )(qf, kf, kf, vf, vf, gf, *halo_ops)
 
     def combine(d2):
         """dk[i] = d2[i, cur-half] + d2[i+1, prev-half]; program 0's
-        prev-half (phantom zero keys) is dropped — exactly the reference
-        semantics where those keys are constants."""
+        prev-half is dropped — for zero halos those keys are constants,
+        and for a real halo its gradient is produced by _halo_grads."""
         cur = d2[:, :, w:]
         nxt = jnp.pad(d2[:, 1:, :w], ((0, 0), (0, 1), (0, 0), (0, 0)))
         return (cur + nxt).reshape(bh, n, d)
 
     dk = combine(dk2).astype(k.dtype).reshape(b, h, n, d)
     dv = combine(dv2).astype(v.dtype).reshape(b, h, n, d)
-    return dq.reshape(b, h, n, d), dk, dv
+    out = (dq.reshape(b, h, n, d), dk, dv)
+    if with_halo:
+        return out + _halo_grads(
+            qf, kf, vf, gf, halo_k, halo_v, w, d, scale, q.shape
+        )
+    return out
+
+
+def _bwd_rule(window_size, scale, interpret, bwd_impl, bh_block, fwd_impl,
+              residuals, g):
+    return _bwd_core(window_size, scale, interpret, bwd_impl, bh_block,
+                     fwd_impl, residuals, g)
 
 
 pallas_local_attention.defvjp(_fwd_rule, _bwd_rule)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def pallas_local_attention_halo(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    halo_k: jnp.ndarray,
+    halo_v: jnp.ndarray,
+    window_size: int,
+    scale: float | None = None,
+    interpret: bool = False,
+    bwd_impl: str = "kv",
+    bh_block: int = 1,
+    fwd_impl: str = "pallas",
+) -> jnp.ndarray:
+    """``pallas_local_attention`` with window 0's "previous window"
+    overridden by ``halo_k``/``halo_v`` (batch, heads, window, dim_head) —
+    the sequence-parallel composition: ring shards exchange one window of
+    k/v over ``ppermute`` (parallel/ring_attention.py) and run this kernel
+    locally, so long-context multi-chip training uses the same measured
+    kernel as single-chip. Exactly equals ``ops.attention.local_attention``
+    with ``first_prev_k/v`` (the golden), including halo gradients (the
+    halo's grad is one tiny window-0 recompute outside the kernel)."""
+    if _parse_bwd_impl(bwd_impl) is None:
+        raise ValueError(f"unknown bwd_impl {bwd_impl!r}")
+    if fwd_impl not in ("pallas", "xla"):
+        raise ValueError(f"unknown fwd_impl {fwd_impl!r}")
+    out, _ = _fwd(q, k, v, window_size, scale, interpret, bh_block,
+                  fwd_impl, halo_k, halo_v)
+    return out
+
+
+def _fwd_rule_halo(q, k, v, halo_k, halo_v, window_size, scale, interpret,
+                   bwd_impl, bh_block, fwd_impl):
+    out, _ = _fwd(q, k, v, window_size, scale, interpret, bh_block,
+                  fwd_impl, halo_k, halo_v)
+    return out, (q, k, v, halo_k, halo_v)
+
+
+def _bwd_rule_halo(window_size, scale, interpret, bwd_impl, bh_block,
+                   fwd_impl, residuals, g):
+    q, k, v, halo_k, halo_v = residuals
+    return _bwd_core(window_size, scale, interpret, bwd_impl, bh_block,
+                     fwd_impl, (q, k, v), g, halo_k, halo_v)
+
+
+pallas_local_attention_halo.defvjp(_fwd_rule_halo, _bwd_rule_halo)

@@ -40,10 +40,17 @@ def ring_local_attention(
     seq_axis: str = "seq",
     batch_axis: str | None = "data",
     scale: float | None = None,
+    use_pallas: bool = False,
 ) -> jnp.ndarray:
     """q, k, v: (batch, heads, n, dim_head), n sharded over ``seq_axis``
     (batch over ``batch_axis`` when given). Returns same shape/sharding.
-    Exactly equal to ``local_attention`` on the gathered arrays."""
+    Exactly equal to ``local_attention`` on the gathered arrays.
+
+    ``use_pallas`` runs each shard's local attention through the measured
+    Pallas kernel (ops/pallas_attention.pallas_local_attention_halo — the
+    halo-aware variant, impls chosen by the policy table at the SHARD's
+    shapes), so long-context multi-chip training composes the two flagship
+    paths instead of falling back to the XLA dense attention per shard."""
     n_shards = mesh.shape[seq_axis]
     _, _, n, _ = q.shape
     w = window_size
@@ -65,6 +72,25 @@ def ring_local_attention(
         zero = jnp.zeros((), halo_k.dtype)
         halo_k = jnp.where(is_first, zero, halo_k)
         halo_v = jnp.where(is_first, zero, halo_v)
+        if use_pallas:
+            from progen_tpu.ops.pallas_attention import (
+                measured_impls,
+                pallas_local_attention_halo,
+            )
+
+            # policy lookup at the LOCAL (per-shard) shapes — what the
+            # kernel actually runs; trace-time Python, so file reads are
+            # fine inside shard_map
+            b_l, h_l, n_l, _ = q.shape
+            fwd_impl, bwd_impl, g = measured_impls(
+                w, n=n_l, bh=b_l * h_l
+            )
+            if not (fwd_impl == "xla" and bwd_impl == "xla"):
+                interpret = jax.default_backend() not in ("tpu", "axon")
+                return pallas_local_attention_halo(
+                    q, k, v, halo_k, halo_v, w, scale, interpret,
+                    bwd_impl, g, fwd_impl,
+                )
         return local_attention(
             q, k, v,
             window_size=w,
@@ -74,9 +100,16 @@ def ring_local_attention(
         )
 
     spec = P(batch_axis, None, seq_axis, None)
+    # check_vma off for the Pallas path: the interpret-mode pallas
+    # lowering mixes kernel-internal constants (no vma) with varying
+    # operands under jax 0.9's varying-manual-axes checker, which rejects
+    # the mul ("Primitive mul requires varying manual axes to match");
+    # jax's own error message prescribes check_vma=False. The XLA path
+    # keeps the checker on.
     return jax.shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        check_vma=not use_pallas,
     )(q, k, v)

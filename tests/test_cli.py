@@ -191,6 +191,30 @@ def test_pipeline_cli_loop(workspace, monkeypatch):
     assert "loss:" in res.output
 
 
+def test_pipeline_cli_1f1b(workspace, monkeypatch):
+    """--pipe_schedule 1f1b: the interleaved schedule end-to-end from the
+    CLI (2 stages x 2 data, 2 microbatches)."""
+    monkeypatch.chdir(workspace)
+    runner = CliRunner()
+
+    from progen_tpu.cli.train import main as train_main
+
+    (workspace / "configs" / "model" / "pipe.toml").write_text(PIPE_TOML)
+    res = runner.invoke(train_main, [
+        "--wandb_off", "--batch_size", "4", "--grad_accum_every", "1",
+        "--num_steps", "2", "--mesh_pipe", "2", "--mesh_data", "2",
+        "--pipe_microbatches", "2", "--pipe_schedule", "1f1b",
+        "--model_name", "pipe",
+        "--validate_every", "1", "--sample_every", "1000",
+        "--checkpoint_every", "1000", "--seq_len", "32",
+        "--config_path", str(workspace / "configs" / "model"),
+        "--data_path", str(workspace / "train_data"),
+        "--checkpoint_path", str(workspace / "ckpts_pipe_1f1b"),
+    ])
+    assert res.exit_code == 0, res.output
+    assert "loss:" in res.output and "valid_loss:" in res.output
+
+
 def test_pipeline_cli_guards(workspace, monkeypatch):
     monkeypatch.chdir(workspace)
     runner = CliRunner()

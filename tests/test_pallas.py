@@ -140,6 +140,93 @@ class TestPallasBackward:
             )(q)
 
 
+class TestHaloVariant:
+    """pallas_local_attention_halo: window 0's previous window supplied by
+    a ring neighbor (parallel/ring_attention.py) instead of the phantom
+    zeros — the sequence-parallel composition. Golden: local_attention
+    with first_prev_k/v."""
+
+    def _args(self, key, shape=(2, 2, 32, 8), w=8):
+        b, h, n, d = shape
+        ks = jax.random.split(jax.random.PRNGKey(key), 5)
+        q, k, v = (jax.random.normal(kk, shape) for kk in ks[:3])
+        hk = jax.random.normal(ks[3], (b, h, w, d))
+        hv = jax.random.normal(ks[4], (b, h, w, d))
+        return q, k, v, hk, hv
+
+    @pytest.mark.parametrize("fwd_impl", ["pallas", "xla"])
+    def test_forward_matches_golden(self, fwd_impl):
+        from progen_tpu.ops.pallas_attention import (
+            pallas_local_attention_halo,
+        )
+
+        q, k, v, hk, hv = self._args(20)
+        out = pallas_local_attention_halo(
+            q, k, v, hk, hv, 8, None, True, "kv", 1, fwd_impl
+        )
+        ref = local_attention(
+            q, k, v, window_size=8, first_prev_k=hk, first_prev_v=hv
+        )
+        np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+    def test_zero_halo_equals_plain(self):
+        from progen_tpu.ops.pallas_attention import (
+            pallas_local_attention_halo,
+        )
+
+        q, k, v, hk, hv = self._args(21)
+        out = pallas_local_attention_halo(
+            q, k, v, jnp.zeros_like(hk), jnp.zeros_like(hv), 8, None, True
+        )
+        plain = pallas_local_attention(q, k, v, 8, None, True)
+        np.testing.assert_allclose(out, plain, atol=1e-6, rtol=1e-6)
+
+    @pytest.mark.parametrize("bwd_impl", ["kv", "kv_g2", "halo", "xla"])
+    def test_all_grads_match_golden(self, bwd_impl):
+        """dq, dk, dv AND d_halo_k/d_halo_v vs XLA autodiff of the golden
+        — the halo grad is what the ring backward ppermutes back to the
+        left neighbor, so it must be exact, not just plausible."""
+        from progen_tpu.ops.pallas_attention import (
+            pallas_local_attention_halo,
+        )
+
+        q, k, v, hk, hv = self._args(22)
+
+        def loss(fn):
+            return lambda *a: (
+                fn(*a) * jnp.arange(q.size).reshape(q.shape)
+            ).sum()
+
+        gp = jax.grad(
+            loss(lambda q_, k_, v_, hk_, hv_: pallas_local_attention_halo(
+                q_, k_, v_, hk_, hv_, 8, None, True, bwd_impl)),
+            argnums=(0, 1, 2, 3, 4),
+        )(q, k, v, hk, hv)
+        gr = jax.grad(
+            loss(lambda q_, k_, v_, hk_, hv_: local_attention(
+                q_, k_, v_, window_size=8,
+                first_prev_k=hk_, first_prev_v=hv_)),
+            argnums=(0, 1, 2, 3, 4),
+        )(q, k, v, hk, hv)
+        for a, b, name in zip(gp, gr, ["dq", "dk", "dv", "dhk", "dhv"]):
+            np.testing.assert_allclose(
+                a, b, atol=2e-3, rtol=2e-3, err_msg=f"{name} mismatch"
+            )
+
+    def test_halo_receives_gradient(self):
+        from progen_tpu.ops.pallas_attention import (
+            pallas_local_attention_halo,
+        )
+
+        q, k, v, hk, hv = self._args(23)
+        ghk = jax.grad(
+            lambda hk_: pallas_local_attention_halo(
+                q, k, v, hk_, hv, 8, None, True
+            ).sum()
+        )(hk)
+        assert float(jnp.abs(ghk).sum()) > 0
+
+
 class TestMixedImpl:
     """fwd_impl="xla" + Pallas backward: the per-direction measured-winner
     combo (BENCH_DETAIL_TPU_r3b: XLA fwd wins at w=256, Pallas bwd wins at
