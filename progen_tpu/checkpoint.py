@@ -258,18 +258,35 @@ def get_checkpoint_fns(
         meta = json.loads(_read_text(last / "meta.json"))
         with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ckptr:
             if abstract_params is None:
-                # shape/dtype skeleton from the checkpoint's own metadata
+                # shape/dtype skeleton from the checkpoint's own metadata,
+                # restored whole onto the default device — exactly what
+                # single-host inference wants
+                dev = jax.sharding.SingleDeviceSharding(jax.devices()[0])
                 meta_tree = (
                     ckptr.metadata(last / "state").item_metadata.tree["params"]
                 )
                 abstract_params = jax.tree.map(
-                    lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype),
+                    lambda m: jax.ShapeDtypeStruct(
+                        m.shape, m.dtype, sharding=dev
+                    ),
                     meta_tree,
                 )
+            # explicit per-leaf restore args: a ShapeDtypeStruct sharding
+            # alone is NOT forwarded to deserialization by this orbax, and
+            # a checkpoint written from a mesh-sharded train state refuses
+            # to restore without a concrete sharding (the "train on a pod,
+            # sample on one host" path)
+            restore_args = jax.tree.map(
+                lambda a: ocp.ArrayRestoreArgs(sharding=a.sharding)
+                if getattr(a, "sharding", None) is not None
+                else ocp.RestoreArgs(),
+                abstract_params,
+            )
             restored = ckptr.restore(
                 last / "state",
                 args=ocp.args.PyTreeRestore(
                     item={"params": abstract_params},
+                    restore_args={"params": restore_args},
                     partial_restore=True,
                 ),
             )
