@@ -94,9 +94,11 @@ def confirm(question: str) -> bool:
                    "depth-sharded path when the layer stack outgrows one "
                    "chip even after TP; repurposes the model axis, so "
                    "mutually exclusive with --mesh_model > 1). Requires "
-                   "scan_layers=true in the model TOML. NOTE: backward is "
-                   "the GPipe autodiff transpose — O(microbatches) "
-                   "activation memory; pair with remat=true")
+                   "scan_layers=true in the model TOML. Composes with "
+                   "--mesh_data: microbatch rows shard over the data axis "
+                   "inside the pipeline. NOTE: backward is the GPipe "
+                   "autodiff transpose — O(microbatches) activation "
+                   "memory; pair with remat=true")
 @click.option("--pipe_microbatches", default=0,
               help="GPipe microbatches per micro-step (0 = same as "
                    "--mesh_pipe); bubble fraction = (P-1)/(M+P-1), so "
@@ -283,6 +285,12 @@ def main(
         mesh_data = -1 if (data_parallel or mesh_seq * mesh_model > 1) else 1
     mesh = make_mesh(data=mesh_data, seq=mesh_seq, model=mesh_model)
 
+    if mesh_pipe > 1 and (batch_size // pipe_m) % mesh.shape["data"]:
+        raise click.UsageError(
+            f"PPxDP composition shards each {batch_size // pipe_m}-row "
+            f"microbatch over the data axis; not divisible by "
+            f"data={mesh.shape['data']}"
+        )
     if ring_attn and mesh.shape["seq"] < 2:
         raise click.UsageError(
             "--ring_attn needs a sequence-parallel mesh (--mesh_seq > 1)"

@@ -43,6 +43,7 @@ def pipeline_apply(
     mesh: Mesh,
     axis: str,
     n_microbatches: int,
+    data_axis: str | None = None,
 ) -> jnp.ndarray:
     """Run L stacked layers as a P-stage pipeline over microbatches.
 
@@ -51,6 +52,10 @@ def pipeline_apply(
       scan_layers layout), sharded/split over mesh axis ``axis`` (P stages,
       L % P == 0 — each stage owns L/P consecutive layers).
     x: (B, ...) global batch, B % n_microbatches == 0.
+    data_axis: optional mesh axis to ALSO shard each microbatch's row dim
+      over (PP x DP composition): every data row then pipelines its own
+      1/D slice of each microbatch instead of redundantly recomputing the
+      full batch. None or a size-1 axis = pure pipeline.
 
     Returns block-sequential-equivalent output (B, ...).
     """
@@ -63,6 +68,13 @@ def pipeline_apply(
     if B % M:
         raise ValueError(f"batch {B} not divisible by {M} microbatches")
     mb = B // M
+    dp = (data_axis is not None and data_axis in mesh.shape
+          and mesh.shape[data_axis] > 1)
+    if dp and mb % mesh.shape[data_axis]:
+        raise ValueError(
+            f"microbatch rows {mb} not divisible by data axis "
+            f"{mesh.shape[data_axis]}"
+        )
     x_mb = x.reshape((M, mb) + x.shape[1:])
 
     def stage_fn(local_params, x_mb):
@@ -93,7 +105,9 @@ def pipeline_apply(
             return left_buf, out
 
         # carry must be marked device-varying over the pipeline axis (jax
-        # 0.9 varying-manual-axes typing for scan-of-ppermute)
+        # 0.9 varying-manual-axes typing for scan-of-ppermute); under DP
+        # composition the zeros_like already inherits the data-varying type
+        # from the sharded input, so only the stage axis needs the cast
         init = jax.lax.pcast(
             jnp.zeros_like(x_mb[0]), (axis,), to="varying"
         )
@@ -106,8 +120,8 @@ def pipeline_apply(
     outs = jax.shard_map(
         stage_fn,
         mesh=mesh,
-        in_specs=(P(axis), P()),
-        out_specs=P(axis),
+        in_specs=(P(axis), P(None, data_axis) if dp else P()),
+        out_specs=P(axis, None, data_axis) if dp else P(axis),
     )(stacked_params, x_mb)
     # outs: (P, T, mb, ...); finished microbatches live on the last stage
     final = outs[n_stages - 1, n_stages - 1 : n_stages - 1 + M]
@@ -122,6 +136,7 @@ def pipeline_forward(
     mesh: Mesh,
     axis: str = "model",
     n_microbatches: int,
+    data_axis: str | None = "data",
 ) -> jnp.ndarray:
     """Full ProGen forward with the uniform block stack executed as a
     pipeline — the model-level integration of ``pipeline_apply``.
@@ -187,6 +202,7 @@ def pipeline_forward(
         mesh=mesh,
         axis=axis,
         n_microbatches=n_microbatches,
+        data_axis=data_axis,
     )
 
     for i in range(n_uniform, c.depth):
@@ -221,7 +237,10 @@ def make_pipeline_train_step(
     """The production train step (EOS-masked CE, grad-accum scan, clip,
     masked AdamW — training/step.make_train_step) with the forward replaced
     by ``pipeline_forward``: the depth-sharded deployment path when the
-    layer stack outgrows one chip even after TP.
+    layer stack outgrows one chip even after TP. Composes with data
+    parallelism: on a mesh with ``data > 1`` each microbatch's rows are
+    sharded over the data axis inside the pipeline (every chip does 1/D of
+    the work; grads psum over data via the shard_map transpose).
 
     Uses ``rules=()``: sharding is explicit (shard_map over ``axis``), so
     GSPMD logical constraints must stay inert — they cannot apply inside

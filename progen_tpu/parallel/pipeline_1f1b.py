@@ -56,6 +56,7 @@ def pipeline_1f1b_loss_and_grads(
     mesh: Mesh,
     axis: str,
     n_microbatches: int,
+    data_axis: str | None = "data",
 ):
     """One 1F1B pass: mean microbatch loss + grads for all three param
     groups.
@@ -68,6 +69,10 @@ def pipeline_1f1b_loss_and_grads(
     stacked_params: leaves with leading axis L, sharded over ``axis`` into
       P stages of L/P layers (the scan_layers layout).
     tokens: (B, L+1) int rows (inputs+targets), B % n_microbatches == 0.
+    data_axis: optional mesh axis to ALSO shard each microbatch's row dim
+      over (PP x DP composition): every data row pipelines its own 1/D
+      slice of each microbatch and grads/loss psum-mean over the axis.
+      None or a size-1 axis = pure pipeline.
 
     Returns (loss, (g_pre, g_stack, g_post)): loss is the mean over
     microbatches; g_stack leaves keep the stacked (L, ...) layout;
@@ -83,6 +88,13 @@ def pipeline_1f1b_loss_and_grads(
     if B % M:
         raise ValueError(f"batch {B} not divisible by {M} microbatches")
     mb = B // M
+    dp = (data_axis is not None and data_axis in mesh.shape
+          and mesh.shape[data_axis] > 1)
+    n_data = mesh.shape[data_axis] if dp else 1
+    if dp and mb % n_data:
+        raise ValueError(
+            f"microbatch rows {mb} not divisible by data axis {n_data}"
+        )
     tokens_mb = tokens.reshape((M, mb) + tokens.shape[1:])
 
     def stage_fn(params_pre, local_params, params_post, tokens_mb):
@@ -102,7 +114,11 @@ def pipeline_1f1b_loss_and_grads(
             lambda pp: fn_pre(pp, tokens_mb[0][..., :-1]), params_pre
         )
         zero_h = jnp.zeros(h_shape.shape, h_shape.dtype)
-        varying = lambda x: jax.lax.pcast(x, (axis,), to="varying")
+        # under DP composition every carried value mixes with data-varying
+        # token shards inside the loop, so the scan carry's vma must carry
+        # BOTH axes from the start (scan requires a fixed carry type)
+        vaxes = (axis, data_axis) if dp else (axis,)
+        varying = lambda x: jax.lax.pcast(x, vaxes, to="varying")
 
         # CRITICAL: differentiate against VARYING copies of the replicated
         # param groups. vjp wrt an invariant input with a varying cotangent
@@ -113,6 +129,17 @@ def pipeline_1f1b_loss_and_grads(
         # explicitly, at the end.
         params_pre = jax.tree.map(varying, params_pre)
         params_post = jax.tree.map(varying, params_post)
+        # same trap under DP composition: local_params arrive varying over
+        # ``axis`` only, so a data-varying cotangent would make the vjp
+        # implicitly psum d_local over data — and the explicit psum at the
+        # end would then double-count by exactly n_data. A data-varying
+        # copy keeps d_local per-shard. (pcast rejects already-varying
+        # axes, so cast over data alone.)
+        if dp:
+            data_varying = lambda x: jax.lax.pcast(
+                x, (data_axis,), to="varying"
+            )
+            local_params = jax.tree.map(data_varying, local_params)
 
         perm_right = [(i, (i + 1) % n_stages) for i in range(n_stages)]
         perm_left = [(i, (i - 1) % n_stages) for i in range(n_stages)]
@@ -199,15 +226,22 @@ def pipeline_1f1b_loss_and_grads(
 
         # only one stage accumulated each of these — psum replicates.
         # grads were accumulated with unit cotangent per microbatch while
-        # the reported loss is the MEAN over M: scale to match.
-        inv_m = 1.0 / M
+        # the reported loss is the MEAN over M (and, under DP, over the
+        # n_data per-shard means): scale to match. Under DP the psums also
+        # reduce over data — each data row holds grads of ITS 1/D rows.
+        inv_m = 1.0 / (M * n_data)
         scale_m = lambda tree: jax.tree.map(
             lambda x: x * jnp.asarray(inv_m, x.dtype), tree
         )
-        g_pre = scale_m(jax.lax.psum(g_pre, axis))
-        g_post = scale_m(jax.lax.psum(g_post, axis))
+        reduce_axes = vaxes
+        g_pre = scale_m(jax.lax.psum(g_pre, reduce_axes))
+        g_post = scale_m(jax.lax.psum(g_post, reduce_axes))
+        if dp:
+            g_stack = jax.tree.map(
+                lambda x: jax.lax.psum(x, data_axis), g_stack
+            )
         g_stack = scale_m(g_stack)
-        loss = jax.lax.psum(loss_acc, axis) / M
+        loss = jax.lax.psum(loss_acc, reduce_axes) / (M * n_data)
         # g_stack stays stage-local; the (1, ...) leading axis is
         # re-stacked to (L, ...) by the P(axis) out_spec
         g_stack = jax.tree.map(lambda x: x[None], g_stack)
@@ -216,7 +250,8 @@ def pipeline_1f1b_loss_and_grads(
     loss, g_pre, g_stack, g_post = jax.shard_map(
         stage_fn,
         mesh=mesh,
-        in_specs=(P(), P(axis), P(), P()),
+        in_specs=(P(), P(axis), P(),
+                  P(None, data_axis) if dp else P()),
         out_specs=(P(), P(), P(axis), P()),
     )(params_pre, stacked_params, params_post, tokens_mb)
     g_stack = jax.tree.map(
@@ -261,7 +296,9 @@ def make_1f1b_train_step(
     test-locked against the plain step), but a stage's live activations
     are bounded by 2*(stages-1) microbatch boundaries instead of GPipe's
     O(n_microbatches). ``config.remat`` additionally checkpoints each
-    layer inside the stage recompute."""
+    layer inside the stage recompute. Composes with data parallelism: on
+    a mesh with ``data > 1`` each microbatch's rows are sharded over the
+    data axis (every chip does 1/D of the work; grads psum over data)."""
     import optax
     from flax import linen as nn
 

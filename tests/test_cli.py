@@ -48,6 +48,15 @@ def workspace(tmp_path_factory):
     (root / "configs" / "data" / "default.toml").write_text(
         DATA_TOML.format(fasta=fasta, out=root / "train_data")
     )
+    # build train_data here so every test in this module is runnable in
+    # isolation (no ordering dependency on test_full_cli_loop's ETL run —
+    # that test still exercises the CLI ETL itself, idempotently)
+    from progen_tpu.cli.generate_data import main as gen_main
+
+    res = CliRunner().invoke(
+        gen_main, ["--data_dir", str(root / "configs" / "data")]
+    )
+    assert res.exit_code == 0, res.output
     return root
 
 
@@ -190,10 +199,24 @@ def test_pipeline_cli_loop(workspace, monkeypatch):
     assert res.exit_code == 0, res.output
     assert "loss:" in res.output
 
+    # regression: the sample CLI's params-only restore must accept a
+    # checkpoint WRITTEN from a mesh-sharded train state (train on a pod,
+    # sample on one host) — orbax refuses a None-sharding skeleton there
+    from progen_tpu.cli.sample import main as sample_main
+
+    res = runner.invoke(
+        sample_main,
+        ["--checkpoint_path", str(ckpts), "--prime",
+         "[tax=Homo sapiens] #", "--top_k", "5"],
+    )
+    assert res.exit_code == 0, res.output
+    assert "params:" in res.output
+
 
 def test_pipeline_cli_1f1b(workspace, monkeypatch):
-    """--pipe_schedule 1f1b: the interleaved schedule end-to-end from the
-    CLI (2 stages x 2 data, 2 microbatches)."""
+    """--pipe_schedule 1f1b composed with DP and ZeRO-1: the interleaved
+    schedule end-to-end from the CLI (2 stages x 2 data, 2 microbatches,
+    AdamW moments additionally sharded over the data axis)."""
     monkeypatch.chdir(workspace)
     runner = CliRunner()
 
@@ -203,7 +226,7 @@ def test_pipeline_cli_1f1b(workspace, monkeypatch):
     res = runner.invoke(train_main, [
         "--wandb_off", "--batch_size", "4", "--grad_accum_every", "1",
         "--num_steps", "2", "--mesh_pipe", "2", "--mesh_data", "2",
-        "--pipe_microbatches", "2", "--pipe_schedule", "1f1b",
+        "--pipe_microbatches", "2", "--pipe_schedule", "1f1b", "--zero1",
         "--model_name", "pipe",
         "--validate_every", "1", "--sample_every", "1000",
         "--checkpoint_every", "1000", "--seq_len", "32",
@@ -213,6 +236,19 @@ def test_pipeline_cli_1f1b(workspace, monkeypatch):
     ])
     assert res.exit_code == 0, res.output
     assert "loss:" in res.output and "valid_loss:" in res.output
+
+    # row-divisibility guard: 4-row batch / 4 microbatches = 1 row per
+    # microbatch, not shardable over data=2
+    res = runner.invoke(train_main, [
+        "--wandb_off", "--batch_size", "4", "--mesh_pipe", "2",
+        "--mesh_data", "2", "--pipe_microbatches", "4",
+        "--model_name", "pipe",
+        "--config_path", str(workspace / "configs" / "model"),
+        "--data_path", str(workspace / "train_data"),
+        "--checkpoint_path", str(workspace / "ckpts_pipe_1f1b_guard"),
+    ])
+    assert res.exit_code != 0
+    assert "PPxDP" in res.output
 
 
 def test_pipeline_cli_guards(workspace, monkeypatch):

@@ -115,6 +115,57 @@ class Test1F1B:
                 got, want,
             )
 
+    @pytest.mark.parametrize("stages,data,microbatches",
+                             [(2, 2, 2), (4, 2, 4), (2, 4, 6)])
+    def test_dp_composition_matches_sequential(
+        self, stages, data, microbatches
+    ):
+        """PP x DP: each microbatch's rows shard over the data axis (every
+        chip does 1/D of the work) and grads/loss psum-mean back — must be
+        bit-compatible with the pure-pipeline math, which is itself pinned
+        to jax.grad of the sequential composition."""
+        params_pre, stacked, params_post = _params(0)
+        B = microbatches * data * 2  # 2 rows per (microbatch, data) shard
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(9), (B, SEQ + 1), 0, VOCAB
+        )
+        mesh = make_mesh(data=data, seq=1, model=stages)
+
+        ref_loss, ref_grads = jax.value_and_grad(
+            _sequential_loss, argnums=(0, 1, 2)
+        )(params_pre, stacked, params_post, tokens, microbatches)
+
+        with mesh:
+            loss, grads = jax.jit(
+                lambda a, b, c, t: pipeline_1f1b_loss_and_grads(
+                    _fn_pre, _block_fn, _fn_loss, a, b, c, t,
+                    mesh=mesh, axis="model", n_microbatches=microbatches,
+                    data_axis="data",
+                )
+            )(params_pre, stacked, params_post, tokens)
+
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+        for got, want, name in zip(grads, ref_grads, ("pre", "stack", "post")):
+            jax.tree.map(
+                lambda a, b: np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5,
+                    err_msg=f"grad group {name}",
+                ),
+                got, want,
+            )
+
+    def test_dp_bad_row_divisibility_raises(self):
+        params_pre, stacked, params_post = _params(1)
+        mesh = make_mesh(data=4, seq=1, model=2)
+        tokens = jnp.zeros((4, SEQ + 1), jnp.int32)  # mb=2 rows, data=4
+        with pytest.raises(ValueError, match="data axis"):
+            pipeline_1f1b_loss_and_grads(
+                _fn_pre, _block_fn, _fn_loss,
+                params_pre, stacked, params_post, tokens,
+                mesh=mesh, axis="model", n_microbatches=2,
+                data_axis="data",
+            )
+
     def test_real_model_train_step_matches_plain(self):
         """One optimizer step through the 1F1B schedule must equal the
         plain scan_layers step: same loss trajectory, same updated params
