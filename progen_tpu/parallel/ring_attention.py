@@ -23,6 +23,8 @@ with window boundaries: seq_len % (S * window_size) == 0).
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
@@ -59,6 +61,10 @@ def ring_local_attention(
             f"seq_len {n} must divide into {n_shards} shards of whole "
             f"{w}-token windows"
         )
+    # decided OUTSIDE shard_map so check_vma below can stay on for
+    # compiled TPU runs (the checker only trips on the interpret-mode
+    # pallas lowering)
+    interpret = jax.default_backend() not in ("tpu", "axon")
 
     def shard_fn(q, k, v):
         # NOTE: deliberately TWO ppermutes. Fusing the k/v halos into one
@@ -86,7 +92,6 @@ def ring_local_attention(
                 w, n=n_l, bh=b_l * h_l
             )
             if not (fwd_impl == "xla" and bwd_impl == "xla"):
-                interpret = jax.default_backend() not in ("tpu", "axon")
                 return pallas_local_attention_halo(
                     q, k, v, halo_k, halo_v, w, scale, interpret,
                     bwd_impl, g, fwd_impl,
@@ -100,16 +105,29 @@ def ring_local_attention(
         )
 
     spec = P(batch_axis, None, seq_axis, None)
-    # check_vma off for the Pallas path: the interpret-mode pallas
-    # lowering mixes kernel-internal constants (no vma) with varying
-    # operands under jax 0.9's varying-manual-axes checker, which rejects
-    # the mul ("Primitive mul requires varying manual axes to match");
-    # jax's own error message prescribes check_vma=False. The XLA path
-    # keeps the checker on.
+    # check_vma off ONLY for the interpret-mode Pallas path: that lowering
+    # mixes kernel-internal constants (no vma) with varying operands under
+    # jax 0.9's varying-manual-axes checker, which rejects the mul
+    # ("Primitive mul requires varying manual axes to match"); jax's own
+    # error message prescribes check_vma=False. Compiled TPU runs and the
+    # XLA path keep the checker on.
+    # Residual risk, documented: the compiled-pallas + checker combination
+    # is untestable off-TPU (multi-chip TPU only). If that lowering ever
+    # trips the checker too, it surfaces at train-step COMPILE time (the
+    # transpose is traced inside the same jit) with jax's own message
+    # prescribing check_vma=False — an immediate startup failure, not a
+    # mid-run one. (A try/except here could not help: the backward is
+    # traced at grad time, outside this frame.) PROGEN_RING_CHECK_VMA=0/1
+    # force-overrides the default, so a failing window can be rescued
+    # without a code change.
+    check_vma = not (use_pallas and interpret)
+    override = os.environ.get("PROGEN_RING_CHECK_VMA")
+    if override in ("0", "1"):
+        check_vma = override == "1"
     return jax.shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_vma=not use_pallas,
+        check_vma=check_vma,
     )(q, k, v)
