@@ -414,6 +414,36 @@ def _train_bench(config_name: str, *, use_pallas=None, recipe=None,
     }
 
 
+def _price_kernel_combos(fwd_cands: dict, bwd_only: dict, t_xb: float):
+    """Pick the deployed (fwd, bwd) kernel combo by pricing the FULL grid,
+    each candidate with the forward time of the forward impl it ACTUALLY
+    pairs (t_xf for xla-fwd combos, the g-batched fwd time for pallas_gN)
+    — a global argmin, so near-tie winners aren't decided greedily on the
+    forward alone.
+
+    fwd_cands: {"xla": t_xf, "pallas_g1": t, "pallas_g<N>": t, ...} fwd
+      times (s). bwd_only: {impl: t} pallas backward-only costs (the
+      measured grad pipelines are pallas-g1-fwd + that bwd, so bwd-only =
+      t_pb[impl] - t_pf). t_xb: the PLAIN XLA autodiff grad pipeline
+      (fwd+bwd total).
+
+    Special cases: fwd=xla + bwd=xla is plain local_attention by the model
+    dispatch (no custom-VJP recompute), priced at t_xb; a bwd="xla" escape
+    hatch under a pallas-fwd custom VJP re-runs the whole XLA forward
+    inside the backward (~t_xb on top of the deployed forward, not
+    t_xb - t_xf).
+
+    Returns (best_fwd_key, fwd_win, bwd_win)."""
+    combos = {("xla", "xla"): t_xb}
+    for fkey, ftime in fwd_cands.items():
+        for impl, bcost in bwd_only.items():
+            combos[(fkey, impl)] = ftime + bcost
+        if fkey != "xla":
+            combos[(fkey, "xla")] = ftime + t_xb
+    best_fwd_key, bwd_win = min(combos, key=combos.get)
+    return best_fwd_key, ("xla" if best_fwd_key == "xla" else "pallas"), bwd_win
+
+
 def _kernel_bench(window: int, n: int = 1024) -> dict:
     """Pallas windowed-attention kernel vs the XLA path, fwd+bwd, at the
     flagship shapes. On TPU the kernel is Mosaic-COMPILED (interpret only
@@ -542,30 +572,13 @@ def _kernel_bench(window: int, n: int = 1024) -> dict:
     bwd_guard = _suspect_fields(bwd_flops, min(t_xb, *t_pb.values()), peak)
     suspect = fwd_guard["timing_suspect"] or bwd_guard["timing_suspect"]
 
-    # winner selection prices DEPLOYED COMBOS, not raw per-direction rows:
-    # every grad timing above is a full fwd+bwd pipeline (t_xb = plain XLA
-    # autodiff; t_pb[impl] = pallas-g1 fwd + that pallas bwd), so the
-    # pallas backwards' own cost is t_pb[impl] - t_pf, while a bwd="xla"
-    # escape hatch deployed under the custom VJP re-runs the whole XLA
-    # forward inside the backward (~t_xb, not t_xb - t_xf). fwd="xla" +
-    # bwd="xla" is expressed as plain local_attention by the model dispatch
-    # (no custom-VJP recompute), priced at t_xb.
     fwd_cands = {"xla": t_xf, "pallas_g1": t_pf,
                  # fwd_ms_g keys are already "pallas_g<N>"
                  **{k: v["ms"] / 1e3 for k, v in fwd_ms_g.items()}}
-    best_fwd_key = min(fwd_cands, key=fwd_cands.get)
     bwd_only = {impl: max(t - t_pf, 1e-9) for impl, t in t_pb.items()}
-    best_pl_bwd = min(bwd_only, key=bwd_only.get)
-    if best_fwd_key == "xla":
-        if t_xb <= t_xf + bwd_only[best_pl_bwd]:
-            fwd_win, bwd_win = "xla", "xla"  # plain path beats any mix
-        else:
-            fwd_win, bwd_win = "xla", best_pl_bwd
-    else:
-        # pallas fwd; an xla backward would cost a full t_xb (recomputes
-        # its own forward under the custom VJP)
-        fwd_win = "pallas"
-        bwd_win = "xla" if t_xb < bwd_only[best_pl_bwd] else best_pl_bwd
+    best_fwd_key, fwd_win, bwd_win = _price_kernel_combos(
+        fwd_cands, bwd_only, t_xb
+    )
     policy_entry = {
         "window": w, "n": n, "bh": b * h,
         "fwd": fwd_win,

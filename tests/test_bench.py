@@ -61,6 +61,31 @@ class TestPhasePlumbing:
         monkeypatch.setattr(bench, "_REPO", tmp_path)
         assert bench._prior_round_value() is None
 
+    def test_kernel_combo_pricing(self, bench):
+        # plain XLA wins when no mix beats the fused autodiff pipeline
+        assert bench._price_kernel_combos(
+            {"xla": 1.0, "pallas_g1": 1.2}, {"kv": 0.9}, 1.8,
+        ) == ("xla", "xla", "xla")
+        # xla fwd + pallas bwd: priced with t_xf, not the pallas fwd
+        assert bench._price_kernel_combos(
+            {"xla": 1.0, "pallas_g1": 1.2}, {"kv": 0.5}, 1.8,
+        ) == ("xla", "xla", "kv")
+        # g-batched fwd + pallas bwd
+        assert bench._price_kernel_combos(
+            {"xla": 1.0, "pallas_g1": 0.9, "pallas_g4": 0.6},
+            {"kv": 0.5, "halo": 0.7}, 1.8,
+        ) == ("pallas_g4", "pallas", "kv")
+
+    def test_kernel_combo_pricing_near_tie_not_greedy(self, bench):
+        # ADVICE r4: a marginally-faster pallas forward must NOT drag the
+        # policy onto a combo whose TOTAL loses to plain XLA — the greedy
+        # fwd-then-bwd pick would ship (pallas_g4, xla) here, paying its
+        # forward twice (0.95 + 1.3 = 2.25) vs plain XLA's 1.3
+        assert bench._price_kernel_combos(
+            {"xla": 1.0, "pallas_g1": 1.1, "pallas_g4": 0.95},
+            {"kv": 0.8}, 1.3,
+        ) == ("xla", "xla", "xla")
+
     def test_prior_round_uses_fallback_carried_tpu_record(
             self, bench, monkeypatch, tmp_path):
         import json
