@@ -133,4 +133,35 @@ with mesh_ring:
     )
     print(f"LOSS_RING {float(metrics_r['loss']):.6f}", flush=True)
 
+# --- phase 4: 1F1B PIPELINE across hosts, composed with DP — the stage
+# axis is deliberately interleaved over the two processes (p0,p1,p0,p1),
+# so EVERY activation/cotangent ppermute hop crosses the process boundary
+# (Gloo here; ICI on a real torus), while the data axis shards each
+# microbatch's rows. Fresh scan_layers init -> the parent compares the
+# loss against its own single-process plain-step baseline.
+from jax.sharding import Mesh
+
+from progen_tpu.parallel.partition import MESH_AXES, PIPELINE_RULES
+from progen_tpu.parallel.pipeline_1f1b import compile_1f1b_train_step
+
+cfg_pipe = dataclasses.replace(CFG, depth=5, scan_layers=True)
+model_pipe = ProGen(cfg_pipe)
+devs = sorted(jax.devices(), key=lambda d: d.id)
+interleaved = [d for pair in zip(devs[:4], devs[4:]) for d in pair]
+mesh_pipe = Mesh(
+    np.array(interleaved).reshape(2, 1, 4), MESH_AXES
+)  # bypass make_mesh: create_device_mesh may reorder the interleave away
+state_p, shardings_p = init_train_state(
+    model_pipe, optimizer, jax.random.PRNGKey(0), CFG.seq_len,
+    mesh=mesh_pipe, rules=PIPELINE_RULES,
+)
+step_p = compile_1f1b_train_step(
+    model_pipe, optimizer, shardings_p, mesh_pipe, n_microbatches=2,
+)
+with mesh_pipe:
+    state_p, metrics_p = step_p(
+        state_p, put_batch(both[None], mesh_pipe, accum_axis=True)
+    )
+    print(f"LOSS_PIPE {float(metrics_p['loss']):.6f}", flush=True)
+
 print("WORKER_OK", flush=True)

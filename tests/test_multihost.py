@@ -61,7 +61,7 @@ def test_two_process_training_matches_single(tmp_path):
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=720)
+            out, _ = p.communicate(timeout=900)
             outs.append(out.decode())
     finally:
         for p in procs:  # never leak workers (they hold the port + CPU)
@@ -82,31 +82,24 @@ def test_two_process_training_matches_single(tmp_path):
     assert len(l0) == 3
     np.testing.assert_allclose(l0, l1, rtol=1e-6)
 
-    # cross-host TENSOR-parallel phase: model axis spans both processes
-    # (every block's all-reduce crosses hosts); same first batch and same
-    # fresh init as step 0 of the DP phase -> identical loss
-    def tp_loss(text):
+    def tagged_loss(text, tag):
         return [
             float(line.split()[1])
             for line in text.splitlines()
-            if line.startswith("LOSS_TP")
+            if line.startswith(tag)
         ]
 
-    (tp0,), (tp1,) = tp_loss(outs[0]), tp_loss(outs[1])
+    # cross-host TENSOR-parallel phase: model axis spans both processes
+    # (every block's all-reduce crosses hosts); same first batch and same
+    # fresh init as step 0 of the DP phase -> identical loss
+    (tp0,), (tp1,) = (tagged_loss(o, "LOSS_TP") for o in outs)
     np.testing.assert_allclose(tp0, tp1, rtol=1e-6)
     np.testing.assert_allclose(tp0, l0[0], rtol=1e-5)
 
     # cross-host RING-attention phase: the seq axis spans the two
     # processes, so every block's k/v halo ppermute crosses hosts; same
     # init + batch as the TP phase -> identical loss
-    def ring_loss(text):
-        return [
-            float(line.split()[1])
-            for line in text.splitlines()
-            if line.startswith("LOSS_RING")
-        ]
-
-    (r0,), (r1,) = ring_loss(outs[0]), ring_loss(outs[1])
+    (r0,), (r1,) = (tagged_loss(o, "LOSS_RING") for o in outs)
     np.testing.assert_allclose(r0, r1, rtol=1e-6)
     np.testing.assert_allclose(r0, l0[0], rtol=1e-5)
 
@@ -132,9 +125,29 @@ def test_two_process_training_matches_single(tmp_path):
     step = jax.jit(make_train_step(model, optimizer))
     _, iter_fn = iterator_from_tfrecords_folder(str(data_dir))
     ds = iter_fn(CFG.seq_len, batch_size=8, loop=True)
+    first_batch = next(ds)[None]
     baseline = []
+    batch = first_batch
     for _ in range(3):
-        batch = next(ds)[None]
         state, metrics = step(state, batch)
         baseline.append(float(metrics["loss"]))
+        batch = next(ds)[None]
     np.testing.assert_allclose(l0, baseline, rtol=1e-5)
+
+    # cross-host 1F1B PIPELINE phase: stage ppermutes hop between the two
+    # processes (interleaved stage axis) with DP-sharded microbatch rows;
+    # 1F1B grads/loss are exact, so the loss must equal the plain step's
+    # on the same scan_layers init + first global batch
+    (p0,), (p1,) = (tagged_loss(o, "LOSS_PIPE") for o in outs)
+    np.testing.assert_allclose(p0, p1, rtol=1e-6)
+
+    import dataclasses
+
+    cfg_pipe = dataclasses.replace(CFG, depth=5, scan_layers=True)
+    model_pipe = ProGen(cfg_pipe)
+    state_p, _ = init_train_state(
+        model_pipe, optimizer, jax.random.PRNGKey(0), CFG.seq_len
+    )
+    step_p = jax.jit(make_train_step(model_pipe, optimizer))
+    _, metrics_p = step_p(state_p, first_batch)
+    np.testing.assert_allclose(p0, float(metrics_p["loss"]), rtol=1e-5)
