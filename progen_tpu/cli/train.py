@@ -450,6 +450,32 @@ def main(
         # on a skip-exhausted iterator
         if len(seq_indices) > 0 and not (num_steps and num_steps <= 0):
             batch = next_super_batch()
+
+        # deferred metrics: the host logs step N-1's loss AFTER step N is
+        # dispatched, so the device always has a step in flight instead of
+        # idling while the host prints/tracks (the reference fetches every
+        # step, train.py:192). Cadence steps flush synchronously so the
+        # non-finite gate always precedes a checkpoint write.
+        pending = None
+
+        def flush_metrics():
+            nonlocal pending
+            if pending is None:
+                return
+            p_step, p_metrics = pending
+            pending = None
+            loss = float(p_metrics["last_micro_loss"])  # host sync fence
+            if not math.isfinite(loss):
+                # failure detection (SURVEY §5): stop before a NaN spreads
+                # into the checkpoint rotation
+                raise RuntimeError(
+                    f"non-finite loss {loss} at step {p_step}; "
+                    f"last checkpoint is intact — restart resumes from it"
+                )
+            perf = timer.tick(effective_batch * config.seq_len)
+            if is_coordinator():
+                print(f"loss: {loss:.4f}")
+            tracker.log({"loss": loss, **(perf or {})}, step=p_step)
         for i, seq_index in enumerate(tqdm.tqdm(seq_indices, mininterval=10)):
             stop = stop_requested["flag"]
             if jax.process_count() > 1:
@@ -481,26 +507,26 @@ def main(
             if not is_last:
                 batch = next_super_batch()
             global_step = start_step + steps_done
-            loss = float(metrics["last_micro_loss"])  # host sync = timing fence
+            # log the PREVIOUS step (already complete — no device stall),
+            # then queue this one
+            flush_metrics()
+            pending = (global_step, metrics)
+            # single source of truth for the cadence triggers: sync_now
+            # MUST cover every condition that writes a checkpoint below,
+            # or a NaN state could enter the rotation unchecked
+            do_ckpt = i % checkpoint_every == 0
+            do_valid = i % validate_every == 0
+            do_sample = i % sample_every == 0
+            if is_last or profiler_active or do_ckpt or do_valid or do_sample:
+                flush_metrics()
             if profiler_active and i >= 4:
                 from jax import profiler as jax_profiler
 
                 jax_profiler.stop_trace()
                 profiler_active = False
-            if not math.isfinite(loss):
-                # failure detection (SURVEY §5): stop before a NaN spreads
-                # into the checkpoint rotation
-                raise RuntimeError(
-                    f"non-finite loss {loss} at step {global_step}; "
-                    f"last checkpoint is intact — restart resumes from it"
-                )
-            perf = timer.tick(effective_batch * config.seq_len)
-            if is_coordinator():
-                print(f"loss: {loss:.4f}")
-            tracker.log({"loss": loss, **(perf or {})}, step=global_step)
 
             next_seq_index = seq_index + effective_batch
-            if i % checkpoint_every == 0:
+            if do_ckpt:
                 save_ckpt(
                     Package(
                         next_seq_index=next_seq_index,
@@ -510,7 +536,7 @@ def main(
                         train_config=train_config,
                     )
                 )
-            if i % validate_every == 0:
+            if do_valid:
                 vloss = float(
                     eval_step(
                         state, put_batch(pad_rows(next(valid_ds)), mesh)
@@ -519,7 +545,7 @@ def main(
                 if is_coordinator():
                     print(f"valid_loss: {vloss:.4f}")
                 tracker.log({"valid_loss": vloss}, step=global_step)
-            if i % sample_every == 0:
+            if do_sample:
                 valid_batch = np.asarray(next(valid_ds))
                 prime = valid_batch[0, 1 : prime_length + 1]  # skip BOS col
                 if jax.process_count() > 1:
@@ -546,6 +572,9 @@ def main(
                     render_sample_html(prime_str, sampled_str),
                     step=global_step,
                 )
+        # stop-flag / exhausted-iterator exits leave the last step queued:
+        # its loss (and the non-finite gate) must land before the final save
+        flush_metrics()
 
     finally:
         # nested so each cleanup runs even if an earlier one raises
