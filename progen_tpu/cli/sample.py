@@ -29,6 +29,13 @@ import jax
 @click.option("--checkpoint_path", default="./ckpts")
 @click.option("--prime", default="")
 @click.option("--top_k", default=25)
+@click.option("--temperature", default=1.0,
+              help="logit temperature before top-k/top-p filtering "
+                   "(1.0 = reference parity)")
+@click.option("--top_p", default=None, type=float,
+              help="nucleus sampling: keep the smallest top-probability "
+                   "set with cumulative mass >= p (combines with --top_k; "
+                   "unset = reference parity)")
 @click.option(
     "--naive",
     default=False,
@@ -41,7 +48,8 @@ import jax
     help="decode this many sequences from the prime in one batched "
     "KV-cache pass (--naive switches to the full-forward batched decode)",
 )
-def main(seed, checkpoint_path, prime, top_k, naive, num_samples):
+def main(seed, checkpoint_path, prime, top_k, temperature, top_p,
+         naive, num_samples):
     from progen_tpu.checkpoint import get_checkpoint_fns
     from progen_tpu.config import ProGenConfig
     from progen_tpu.data.tokenizer import decode_tokens, encode_tokens
@@ -77,6 +85,7 @@ def main(seed, checkpoint_path, prime, top_k, naive, num_samples):
         sampled = batched_fn(
             jax.random.PRNGKey(seed), model, params, primes,
             config.seq_len, top_k=top_k, add_bos=True,
+            temperature=temperature, top_p=top_p,
         )
         print("\n", prime, "\n", "*" * 40)
         for row in np.asarray(sampled):
@@ -92,6 +101,8 @@ def main(seed, checkpoint_path, prime, top_k, naive, num_samples):
         config.seq_len,
         top_k=top_k,
         add_bos=True,
+        temperature=temperature,
+        top_p=top_p,
     )
     sampled_str = decode_tokens(np.asarray(sampled)[prime_length:])
     print("\n", prime, "\n", "*" * 40, "\n", sampled_str)

@@ -7,7 +7,9 @@ Behavioral parity (/root/reference/progen_transformer/utils.py:97-135):
     zeroed entries still compete in the argmax at value 0, so a token
     outside the top-k can win if every top-k ``logit + gumbel`` lands below
     0. Kept for parity and because it is vanishingly rare with trained
-    logits (document-don't-silently-fix);
+    logits (document-don't-silently-fix). The beyond-reference
+    temperature/top_p paths do NOT inherit it — tempering makes the
+    all-kept-negative case common, so they mask with finfo.min;
   * ``add_bos`` shifts the prime right by one (utils.py:110-111);
   * post-hoc truncation: everything after the SECOND zero is zeroed (BOS is
     the first; the emitted EOS is the second, utils.py:132-133).
@@ -44,14 +46,79 @@ def select_top_k(logits: jnp.ndarray, k: int):
     return mask, jnp.where(mask, logits, 0.0)
 
 
-def _gumbel_topk_step(key, logit, top_k):
-    """One Gumbel-max top-k draw (shared by both decode paths so the
-    sampling quirks stay in lockstep). Returns (new_key, sampled_id)."""
+def select_top_p(logits: jnp.ndarray, p) -> jnp.ndarray:
+    """Nucleus mask over the last axis: the smallest set of
+    highest-probability tokens whose cumulative softmax mass reaches ``p``
+    (the crossing token included, so for p > 0 at least one survives).
+    ``p`` may be a traced scalar; p >= 2.0 is the keep-all sentinel."""
+    sort_idx = jnp.argsort(-logits, axis=-1)
+    sorted_logits = jnp.take_along_axis(logits, sort_idx, axis=-1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = (cum - probs) < p  # mass BEFORE each token still short of p
+    inv = jnp.argsort(sort_idx, axis=-1)
+    return jnp.take_along_axis(keep_sorted, inv, axis=-1)
+
+
+_TOP_P_OFF = 2.0  # select_top_p keep-all sentinel (any p >= 1 + max prob)
+
+
+def _validate_knobs(temperature, top_p):
+    """Range checks for the beyond-reference sampling knobs (raised from
+    the public entry points, before any compile is paid)."""
+    import math
+
+    try:
+        t = float(temperature)
+    except (TypeError, ValueError):
+        t = float("nan")
+    if not (math.isfinite(t) and t > 0.0):
+        raise ValueError(
+            f"temperature must be a positive finite float, got {temperature}"
+        )
+    if top_p is not None and not 0.0 < float(top_p) <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+
+
+def _knob_operands(temperature, top_p):
+    """(parity, temperature_arr, top_p_arr): ``parity`` is the trace-time
+    branch selector (defaults -> the exact reference quirk path); the float
+    values ride as traced operands so sweeping them re-EXECUTES the same
+    compiled decode instead of retracing it per value."""
+    parity = temperature == 1.0 and top_p is None
+    return (
+        parity,
+        jnp.float32(temperature),
+        jnp.float32(_TOP_P_OFF if top_p is None else top_p),
+    )
+
+
+def _gumbel_topk_step(key, logit, top_k, parity=True, temperature=1.0,
+                      top_p=_TOP_P_OFF):
+    """One Gumbel-max draw (shared by both decode paths so the sampling
+    quirks stay in lockstep). Returns (new_key, sampled_id).
+
+    ``parity=True`` (the default-knobs path) reproduces the reference
+    sampler bit-for-bit, INCLUDING its zeroing quirk: filtered tokens keep
+    score 0 in the argmax (utils.py:106-135). With temperature/top_p
+    engaged that quirk would be a real bug — dividing by a small
+    temperature makes every kept score negative whenever the max logit is
+    negative, so a zero-scored FILTERED token would win — hence the
+    non-parity path masks with finfo.min instead. ``temperature``/``top_p``
+    are traced scalars (top_p = 2.0 keeps all)."""
     key, sub = jax.random.split(key)
     noise = gumbel_noise(sub, logit.shape)
+    if parity:
+        if top_k is not None:
+            mask, logit = select_top_k(logit, top_k)
+            noise = noise * mask
+        return key, jnp.argmax(logit + noise, axis=-1)
+    logit = logit / temperature
+    mask = select_top_p(logit, top_p)
     if top_k is not None:
-        mask, logit = select_top_k(logit, top_k)
-        noise = noise * mask
+        k_mask, _ = select_top_k(logit, top_k)
+        mask = mask & k_mask
+    logit = jnp.where(mask, logit, jnp.finfo(logit.dtype).min)
     return key, jnp.argmax(logit + noise, axis=-1)
 
 
@@ -81,7 +148,10 @@ def _prepare_seq(model, prime, length, add_bos):
     return jnp.pad(prime, widths), start
 
 
-@functools.partial(jax.jit, static_argnames=("model", "length", "top_k"))
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "length", "top_k", "parity"),
+)
 def _decode(
     model,
     params,
@@ -90,6 +160,9 @@ def _decode(
     start_pos: jnp.ndarray,
     length: int,
     top_k: Optional[int],
+    parity: bool = True,
+    temperature: jnp.ndarray = 1.0,
+    top_p: jnp.ndarray = _TOP_P_OFF,
 ):
     """seq: (length,) int32 buffer primed up to start_pos. One fori_loop
     iteration = one full forward + one Gumbel top-k draw + one scatter."""
@@ -100,7 +173,9 @@ def _decode(
         logit = jax.lax.dynamic_index_in_dim(
             logits, pos - 1, axis=0, keepdims=False
         )
-        key, sampled = _gumbel_topk_step(key, logit, top_k)
+        key, sampled = _gumbel_topk_step(
+            key, logit, top_k, parity, temperature, top_p
+        )
         seq = jax.lax.dynamic_update_index_in_dim(
             seq, sampled.astype(seq.dtype), pos, axis=0
         )
@@ -120,15 +195,21 @@ def sample(
     length: int,
     top_k: Optional[int] = 25,
     add_bos: bool = False,
+    temperature: float = 1.0,
+    top_p: Optional[float] = None,
 ) -> jnp.ndarray:
     """Generate a (length,) token sequence continuing ``prime`` (1-D ints).
 
     Defaults mirror sample.py:70 (top_k=25; train-loop sampling uses
-    add_bos=True, train.py:218).
+    add_bos=True, train.py:218). ``temperature``/``top_p`` are
+    beyond-reference knobs; defaults are exact parity.
     """
+    _validate_knobs(temperature, top_p)
+    parity, t_arr, p_arr = _knob_operands(temperature, top_p)
     seq, start = _prepare_seq(model, prime, length, add_bos)
     return _decode(
-        model, params, key, seq, jnp.asarray(start), length, top_k
+        model, params, key, seq, jnp.asarray(start), length, top_k,
+        parity, t_arr, p_arr,
     )
 
 
@@ -140,6 +221,8 @@ def sample_batched(
     length: int,
     top_k: Optional[int] = 25,
     add_bos: bool = False,
+    temperature: float = 1.0,
+    top_p: Optional[float] = None,
 ) -> jnp.ndarray:
     """Batched decode: ``primes`` (batch, prime_len) -> (batch, length).
 
@@ -148,10 +231,15 @@ def sample_batched(
     reference is single-sequence only (utils.py:106) — batching the decode
     keeps the MXU busy on a mesh instead of wasting it on batch-1 matmuls.
     """
+    _validate_knobs(temperature, top_p)
+    parity, t_arr, p_arr = _knob_operands(temperature, top_p)
     primes, batch, keys = _batched_primes_and_keys(key, primes)
     seqs, start = _prepare_seq(model, primes, length, add_bos)
     return jax.vmap(
-        lambda k, s: _decode(model, params, k, s, jnp.asarray(start), length, top_k)
+        lambda k, s: _decode(
+            model, params, k, s, jnp.asarray(start), length, top_k,
+            parity, t_arr, p_arr,
+        )
     )(keys, seqs)
 
 
@@ -201,6 +289,8 @@ def sample_fast(
     length: int,
     top_k: Optional[int] = 25,
     add_bos: bool = False,
+    temperature: float = 1.0,
+    top_p: Optional[float] = None,
 ) -> jnp.ndarray:
     """KV-cache decode: O(2w·d) attention per emitted token via the model's
     config.decode mode (rolling two-window ring buffer + token-shift states
@@ -213,9 +303,11 @@ def sample_fast(
     # key, preserving this function's historical stream); vmapped PRNG
     # draws are bitwise equal to unbatched ones, which the batched-row
     # parity tests pin empirically
+    _validate_knobs(temperature, top_p)
+    parity, t_arr, p_arr = _knob_operands(temperature, top_p)
     out = _decode_incremental_batched(
         dec_model, params, cache, key[None], seq[None],
-        jnp.asarray(start), length, top_k,
+        jnp.asarray(start), length, top_k, parity, t_arr, p_arr,
     )
     return out[0]
 
@@ -247,9 +339,13 @@ def _decode_setup(model, params, batch: int):
     return dec_model, params, init_fn()
 
 
-@functools.partial(jax.jit, static_argnames=("model", "length", "top_k"))
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "length", "top_k", "parity"),
+)
 def _decode_incremental_batched(
-    model, params, cache, keys, seqs, start_pos, length, top_k
+    model, params, cache, keys, seqs, start_pos, length, top_k,
+    parity=True, temperature=1.0, top_p=_TOP_P_OFF,
 ):
     """Batched KV-cache decode: seqs (B, length), keys (B,) — one
     independent Gumbel stream per row, caches carry a leading batch axis
@@ -268,7 +364,11 @@ def _decode_incremental_batched(
 
     cache = jax.lax.fori_loop(0, start_pos - 1, prefill, cache)
 
-    draw = jax.vmap(functools.partial(_gumbel_topk_step, top_k=top_k))
+    draw = jax.vmap(
+        lambda k, l: _gumbel_topk_step(
+            k, l, top_k, parity, temperature, top_p
+        )
+    )
 
     def gen(p, carry):
         seqs, cache, keys = carry
@@ -294,6 +394,8 @@ def sample_fast_batched(
     length: int,
     top_k: Optional[int] = 25,
     add_bos: bool = False,
+    temperature: float = 1.0,
+    top_p: Optional[float] = None,
 ) -> jnp.ndarray:
     """Batched KV-cache decode: ``primes`` (batch, prime_len) ->
     (batch, length), O(B·2w·d) attention per emitted step. Row i is
@@ -301,10 +403,12 @@ def sample_fast_batched(
     (and therefore to ``sample_batched``'s row i) — same per-row Gumbel
     streams, decoded together so the MXU sees batched matmuls instead of
     batch-1 throwaway work."""
+    _validate_knobs(temperature, top_p)
+    parity, t_arr, p_arr = _knob_operands(temperature, top_p)
     primes, batch, keys = _batched_primes_and_keys(key, primes)
     seqs, start = _prepare_seq(model, primes, length, add_bos)
     dec_model, params, cache = _decode_setup(model, params, batch=batch)
     return _decode_incremental_batched(
         dec_model, params, cache, keys, seqs, jnp.asarray(start), length,
-        top_k,
+        top_k, parity, t_arr, p_arr,
     )

@@ -233,6 +233,108 @@ class TestIncrementalDecode:
         np.testing.assert_array_equal(naive, fast)
 
 
+class TestTemperatureTopP:
+    """Beyond-reference sampler knobs; defaults must stay exact parity."""
+
+    def test_select_top_p_numpy_golden(self):
+        from progen_tpu.sampling import select_top_p
+
+        logits = jnp.log(jnp.array([0.4, 0.3, 0.2, 0.05, 0.05]))
+        # cumulative mass before each token (sorted): 0, .4, .7, .9, .95
+        np.testing.assert_array_equal(
+            select_top_p(logits, 0.65), [True, True, False, False, False]
+        )
+        np.testing.assert_array_equal(  # crossing token included
+            select_top_p(logits, 0.75), [True, True, True, False, False]
+        )
+        np.testing.assert_array_equal(  # always keeps the argmax
+            select_top_p(logits, 1e-6), [True, False, False, False, False]
+        )
+
+    def test_top_p_one_is_no_filter(self, model_and_params):
+        model, params = model_and_params
+        prime = jnp.array([5, 9, 11], jnp.int32)
+        a = sample(jax.random.PRNGKey(3), model, params, prime,
+                   TINY.seq_len, top_k=None, add_bos=True)
+        b = sample(jax.random.PRNGKey(3), model, params, prime,
+                   TINY.seq_len, top_k=None, add_bos=True, top_p=1.0)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_defaults_bitwise_parity(self, model_and_params):
+        model, params = model_and_params
+        prime = jnp.array([5, 9, 11], jnp.int32)
+        a = sample(jax.random.PRNGKey(3), model, params, prime,
+                   TINY.seq_len, top_k=10, add_bos=True)
+        b = sample(jax.random.PRNGKey(3), model, params, prime,
+                   TINY.seq_len, top_k=10, add_bos=True,
+                   temperature=1.0, top_p=None)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_fast_matches_naive_with_knobs(self, model_and_params):
+        from progen_tpu.sampling import sample_fast
+
+        model, params = model_and_params
+        prime = jnp.array([5, 9, 11], jnp.int32)
+        kw = dict(top_k=10, add_bos=True, temperature=0.7, top_p=0.9)
+        naive = sample(jax.random.PRNGKey(4), model, params, prime,
+                       TINY.seq_len, **kw)
+        fast = sample_fast(jax.random.PRNGKey(4), model, params, prime,
+                           TINY.seq_len, **kw)
+        np.testing.assert_array_equal(np.asarray(naive), np.asarray(fast))
+
+    def test_filtered_tokens_cannot_win_with_knobs(self):
+        # regression (round-5 review): the parity path's zeroing quirk must
+        # NOT leak into the knob paths — with all kept logits negative after
+        # tempering, a zero-scored filtered token would win the argmax
+        from progen_tpu.sampling import _gumbel_topk_step
+
+        logit = jnp.array([-2.0, -3.0, -4.0, -5.0, -6.0, -7.0])
+        for i in range(20):
+            _, tok = _gumbel_topk_step(
+                jax.random.PRNGKey(i), logit, 2, parity=False,
+                temperature=jnp.float32(0.1),
+                top_p=jnp.float32(2.0),
+            )
+            assert int(tok) in (0, 1)  # strictly inside the top-2 set
+
+    def test_knob_validation(self, model_and_params):
+        model, params = model_and_params
+        prime = jnp.array([5, 9, 11], jnp.int32)
+        for bad in (dict(temperature=0.0), dict(temperature=-1.0),
+                    dict(temperature=float("inf")), dict(top_p=0.0),
+                    dict(top_p=1.5)):
+            with pytest.raises(ValueError, match="temperature|top_p"):
+                sample(jax.random.PRNGKey(0), model, params, prime,
+                       TINY.seq_len, top_k=5, add_bos=True, **bad)
+
+    def test_knob_sweep_shares_one_compile(self, model_and_params):
+        # temperature/top_p ride as traced operands: sweeping values must
+        # re-execute the SAME compiled decode, not retrace per value
+        from progen_tpu.sampling import _decode
+
+        model, params = model_and_params
+        prime = jnp.array([5, 9, 11], jnp.int32)
+        before = _decode._cache_size()
+        for t in (0.7, 0.8, 0.9):
+            sample(jax.random.PRNGKey(0), model, params, prime,
+                   TINY.seq_len, top_k=5, add_bos=True, temperature=t,
+                   top_p=0.95)
+        assert _decode._cache_size() == before + 1
+
+    def test_low_temperature_is_near_greedy(self, model_and_params):
+        # tau -> 0 turns the Gumbel draw into argmax over the kept set:
+        # two different keys must produce the same continuation
+        model, params = model_and_params
+        prime = jnp.array([5, 9, 11], jnp.int32)
+        a = sample(jax.random.PRNGKey(1), model, params, prime,
+                   TINY.seq_len, top_k=None, add_bos=True,
+                   temperature=1e-4)
+        b = sample(jax.random.PRNGKey(2), model, params, prime,
+                   TINY.seq_len, top_k=None, add_bos=True,
+                   temperature=1e-4)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 class TestBatchedFastDecode:
     def test_rows_bit_identical_to_single_fast(self, model_and_params):
         """sample_fast_batched row i == sample_fast(fold_in(key, i)) — the
