@@ -244,22 +244,28 @@ class TestPipelineForwardRealModel:
 
         model, params, tokens, _ = setup
         rmodel = ProGen(dataclasses.replace(model.config, remat=True))
-        mesh = make_mesh(data=1, seq=1, model=4)
         g_ref = jax.grad(
             lambda p: model.apply({"params": p}, tokens).sum()
         )(params)
-        g_remat = jax.grad(
-            lambda p: pipeline_forward(
-                rmodel, p, tokens, mesh=mesh, n_microbatches=4
-            ).sum()
-        )(params)
-        jax.tree.map(
-            lambda a, b: np.testing.assert_allclose(
-                np.asarray(a), np.asarray(b), rtol=2e-5, atol=5e-3
-            ),
-            g_ref,
-            g_remat,
-        )
+        # remat alone (tight), and remat composed with DP-sharded
+        # microbatch rows (looser atol 2e-2: the un-normalized .sum()
+        # objective yields grads up to ~3e3 and the DP psum reassociates
+        # the f32 reduction — measured worst deviation 8e-3, while a real
+        # double-count would be O(|grad|))
+        for mesh, atol in ((make_mesh(data=1, seq=1, model=4), 5e-3),
+                           (make_mesh(data=2, seq=1, model=4), 2e-2)):
+            g_remat = jax.grad(
+                lambda p: pipeline_forward(
+                    rmodel, p, tokens, mesh=mesh, n_microbatches=4
+                ).sum()
+            )(params)
+            jax.tree.map(
+                lambda a, b: np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=2e-5, atol=atol
+                ),
+                g_ref,
+                g_remat,
+            )
 
     def test_unrolled_layout_rejected(self, setup):
         from progen_tpu.parallel.pipeline import pipeline_forward

@@ -166,10 +166,18 @@ class Test1F1B:
                 data_axis="data",
             )
 
-    def test_real_model_train_step_matches_plain(self):
+    @pytest.mark.parametrize(
+        "data,stages,microbatches",
+        [(1, 4, 4),  # pure pipeline
+         (2, 2, 2)],  # composed with DP: microbatch rows sharded over data
+    )
+    def test_real_model_train_step_matches_plain(
+        self, data, stages, microbatches
+    ):
         """One optimizer step through the 1F1B schedule must equal the
         plain scan_layers step: same loss trajectory, same updated params
-        — the whole-schedule grad-exactness claim at the model level."""
+        — the whole-schedule grad-exactness claim at the model level,
+        with and without DP composition."""
         from progen_tpu.config import ProGenConfig
         from progen_tpu.models.progen import ProGen
         from progen_tpu.parallel.pipeline_1f1b import make_1f1b_train_step
@@ -196,12 +204,12 @@ class Test1F1B:
         )
         s_ref, m_ref = jax.jit(make_train_step(model, optimizer))(s0, batch)
 
-        mesh = make_mesh(data=1, seq=1, model=4)
+        mesh = make_mesh(data=data, seq=1, model=stages)
         s1, _ = init_train_state(
             model, optimizer, jax.random.PRNGKey(0), cfg.seq_len
         )
         step = make_1f1b_train_step(
-            model, optimizer, mesh=mesh, n_microbatches=4
+            model, optimizer, mesh=mesh, n_microbatches=microbatches
         )
         with mesh:
             s_pipe, m_pipe = jax.jit(step)(s1, batch)
