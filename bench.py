@@ -1052,11 +1052,7 @@ def _data_io_bench() -> dict:
 
     from progen_tpu.data import _native
     from progen_tpu.data.dataset import collate as py_collate
-    from progen_tpu.data.tfrecord import (
-        encode_example,
-        read_tfrecords,
-        tfrecord_writer,
-    )
+    from progen_tpu.data.tfrecord import read_tfrecords, tfrecord_writer
 
     with tempfile.TemporaryDirectory() as td:
         path = f"{td}/bench.{n_rec}.tfrecord.gz"
