@@ -156,6 +156,9 @@ _PHASES = (
     ("train-long8k", 1500),
     ("train-tiny-pallas", 1500),
     ("decode-tiny", 600),
+    # serving engine under staggered arrivals (steady-state tokens/s +
+    # TTFT); two jits only, shapes shared with decode-tiny's policy
+    ("decode-serve", 600),
     # sustained base run: 100+ steps + async ckpt + exactness-checked
     # restore (the production-claim proxy); long, so late in the order
     ("sustain-base", 1200),
@@ -1032,6 +1035,143 @@ def _decode_bench() -> dict:
     }
 
 
+def _decode_serve_bench() -> dict:
+    """Continuous-batching serving engine (progen_tpu/serving/) under
+    staggered arrivals: steady-state decode tokens/s across the slot
+    pool and per-request time-to-first-token. One warmup request pays
+    both compiles (prefill + decode step) OUTSIDE the measured window;
+    the engine's decode_step reads its outputs back to the host every
+    iteration, so the timings are honest host-observed wall clock (the
+    same property _value_fence enforces elsewhere)."""
+    import jax
+    import jax.numpy as jnp
+    from flax import linen as nn
+
+    from progen_tpu.data.tokenizer import encode_tokens
+    from progen_tpu.models.progen import ProGen
+    from progen_tpu.serving import (
+        Request,
+        Scheduler,
+        ServeEngine,
+        ServingMetrics,
+    )
+
+    on_tpu = _is_tpu_platform(jax.devices()[0].platform)
+    # same shape policy as decode-tiny: half-context tiny on TPU (three
+    # jits already blew a full-length phase window once), smoke on CPU
+    config = (
+        _load_config("tiny", seq_len=512)
+        if on_tpu
+        else _load_config("smoke")
+    )
+    max_slots = 8 if on_tpu else 4
+    n_requests = 16 if on_tpu else 8
+    model = ProGen(config)
+    tokens = jnp.zeros((1, config.seq_len), jnp.int32)
+    params = nn.meta.unbox(
+        jax.jit(model.init)(jax.random.PRNGKey(0), tokens)["params"]
+    )
+    prime = jnp.asarray(encode_tokens("[tax=Mammalia] #"), jnp.int32)
+
+    _mark(f"serve init: slots={max_slots} seq_len={config.seq_len}")
+    engine = ServeEngine(model, params, max_slots=max_slots,
+                         max_len=config.seq_len)
+    sched = Scheduler(engine, max_queue=2 * n_requests)
+
+    # warmup: one short request end-to-end = both compiles + cache init
+    t0 = time.perf_counter()
+    ok, _ = sched.submit(
+        Request(id="warm", prime=prime, length=int(prime.shape[0]) + 8,
+                add_bos=True, key=jax.random.PRNGKey(0))
+    )
+    assert ok
+    sched.run_to_completion(max_steps=2000)
+    compile_s = time.perf_counter() - t0
+    _mark(f"serve warm in {compile_s:.1f}s")
+
+    # measured window on fresh metrics: staggered arrivals — half the
+    # load up front, the rest dripped in one per 4 decode steps, so the
+    # pool sees admissions landing mid-flight (the continuous-batching
+    # case, not a static batch)
+    sched.metrics = metrics = ServingMetrics()
+    gen_len = int(config.seq_len) if on_tpu else 96
+    reqs = [
+        Request(
+            id=f"r{i}", prime=prime,
+            # mixed lengths: 50%..100% of the window
+            length=int(prime.shape[0]) + 1
+            + max(8, (gen_len - int(prime.shape[0]) - 1)
+                  * (2 + i % 3) // 4),
+            add_bos=True, key=jax.random.PRNGKey(100 + i),
+            temperature=(0.8 if i % 3 == 1 else 1.0),
+            top_p=(0.95 if i % 3 == 2 else None),
+        )
+        for i in range(n_requests)
+    ]
+    pending = list(reqs)
+    for req in pending[: n_requests // 2]:
+        ok, reason = sched.submit(req)
+        assert ok, reason
+    pending = pending[n_requests // 2:]
+    t0 = time.perf_counter()
+    steps = 0
+    completions = []
+    while sched.has_work or pending:
+        if pending and steps % 4 == 0:
+            ok, reason = sched.submit(pending.pop(0))
+            assert ok, reason
+        _, comp = sched.step()
+        completions.extend(comp)
+        steps += 1
+        if steps % 100 == 0:
+            _mark(f"serve step {steps}: {len(completions)}/{n_requests}")
+        if steps > 100000:
+            raise RuntimeError("serving bench failed to drain")
+    wall = time.perf_counter() - t0
+    m = metrics.snapshot()
+    _mark(f"serve drained: {steps} steps in {wall:.1f}s")
+
+    from progen_tpu import profiling as _prof
+
+    peak = _prof.peak_flops(jax.devices()[0])
+    fwd_tok = _prof.flops_per_token(config) / 3
+    guard = _suspect_fields(
+        m.get("decode_tokens_per_s", 0.0) * fwd_tok, 1.0, peak
+    )
+    return {
+        "phase": "decode-serve",
+        "timing_suspect": guard["timing_suspect"],
+        "implied_device_tflops": guard["implied_device_tflops"],
+        "config": "tiny-seq512" if on_tpu else "smoke",
+        "max_slots": max_slots,
+        "n_requests": n_requests,
+        "completed": int(m.get("requests_completed", 0)),
+        "steady_state_tokens_per_sec": round(
+            m.get("decode_tokens_per_s", 0.0), 1
+        ),
+        "wall_tokens_per_sec": round(
+            m.get("decode_tokens", 0.0) / max(wall, 1e-9), 1
+        ),
+        "prefill_tokens_per_sec": round(
+            m.get("prefill_tokens_per_s", 0.0), 1
+        ),
+        "ttft_mean_s": round(m.get("ttft_s_mean_s", 0.0), 4),
+        "ttft_max_s": round(m.get("ttft_s_max_s", 0.0), 4),
+        "request_latency_mean_s": round(
+            m.get("latency_s_mean_s", 0.0), 4
+        ),
+        "decode_steps": int(m.get("decode_steps", 0)),
+        "mean_occupancy": round(
+            m.get("decode_tokens", 0.0)
+            / max(m.get("decode_steps", 1.0), 1.0),
+            2,
+        ),
+        "compile_s": round(compile_s, 1),
+        "platform": jax.devices()[0].platform,
+        **_hbm_stats(),
+    }
+
+
 def _data_io_bench() -> dict:
     """Host-side input-pipeline throughput: the from-scratch TFRecord
     codec (write + parse) and the C++ engine vs the pure-Python path, plus
@@ -1260,6 +1400,8 @@ def run_phase(name: str) -> dict:
         return _calib_bench()
     if name == "decode-tiny":
         return _decode_bench()
+    if name == "decode-serve":
+        return _decode_serve_bench()
     if name == "sustain-base":
         return _sustain_bench()
     if name == "sgu-mix":

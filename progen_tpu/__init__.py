@@ -15,7 +15,8 @@ on the trailing `global_mlp_depth` layers.
 
 __version__ = "0.1.0"
 
-__all__ = ["ProGen", "ProGenConfig", "__version__"]
+__all__ = ["ProGen", "ProGenConfig", "ServeEngine", "Scheduler",
+           "__version__"]
 
 
 def __getattr__(name):  # PEP 562: lazy so that importing light submodules
@@ -29,4 +30,8 @@ def __getattr__(name):  # PEP 562: lazy so that importing light submodules
         from progen_tpu.config import ProGenConfig
 
         return ProGenConfig
+    if name in ("ServeEngine", "Scheduler"):
+        import progen_tpu.serving as serving
+
+        return getattr(serving, name)
     raise AttributeError(f"module 'progen_tpu' has no attribute {name!r}")

@@ -1,0 +1,340 @@
+"""Serving CLI — continuous-batching engine loop against a checkpoint.
+
+Runs the slot-pool engine (progen_tpu/serving/) as a single-threaded
+event loop. Requests arrive as JSON lines, one object per request:
+
+    {"id": "r1", "prime": "[tax=Mammalia] #", "length": 256,
+     "temperature": 0.8, "top_p": 0.95, "top_k": 25, "seed": 7}
+
+(``id`` and ``prime`` required; everything else optional — ``length``
+defaults to --max-len.) Responses stream back as JSON lines, one per
+event, interleaved across requests as the engine produces them:
+
+    {"event": "token", "id": "r1", "token": 77, "text": "L", "index": 18}
+    {"event": "done", "id": "r1", "text": "...", "n_generated": 238,
+     "ttft_s": 0.01, "latency_s": 0.9}
+    {"event": "rejected", "id": "r9", "reason": "queue_full"}
+
+Two transports, same protocol:
+  * default: requests on stdin, events on stdout (pipe-friendly;
+    EOF drains the queue and exits);
+  * --socket PATH: a unix domain socket server; each connection
+    submits requests and receives exactly its own events.
+
+Run: python -m progen_tpu.cli.serve --max-slots 8 --max-queue 64
+"""
+
+from __future__ import annotations
+
+from progen_tpu.utils.env import load_env_file
+
+load_env_file()  # XLA/env flags before jax import (ref train.py:1-2)
+
+import json
+import os
+import select
+import socket
+import sys
+
+import click
+import numpy as np
+
+
+def _parse_request(line, defaults):
+    """JSONL line -> (Request, error_string). Tokenizes the prime and
+    applies server defaults; malformed input becomes a rejection event
+    rather than a crash (a server must outlive its worst client)."""
+    from progen_tpu.data.tokenizer import encode_tokens
+    from progen_tpu.serving import Request
+
+    try:
+        obj = json.loads(line)
+        if not isinstance(obj, dict):
+            raise ValueError("request must be a JSON object")
+        rid = str(obj["id"])
+    except (ValueError, KeyError) as e:
+        return None, f"bad request line: {e}"
+    try:
+        prime = np.asarray(
+            encode_tokens(str(obj.get("prime", ""))), dtype=np.int32
+        )
+        req = Request(
+            id=rid,
+            prime=prime,
+            length=int(obj.get("length", defaults["length"])),
+            top_k=(None if obj.get("top_k", defaults["top_k"]) is None
+                   else int(obj.get("top_k", defaults["top_k"]))),
+            add_bos=True,  # server parity with cli/sample.py
+            temperature=float(
+                obj.get("temperature", defaults["temperature"])
+            ),
+            top_p=(None if obj.get("top_p", defaults["top_p"]) is None
+                   else float(obj.get("top_p", defaults["top_p"]))),
+            seed=int(obj.get("seed", defaults["seed"])),
+        )
+        return req, None
+    except (ValueError, TypeError) as e:
+        # keep the id so the rejection can still be routed to its request
+        return (
+            Request(id=rid, prime=np.zeros(0, np.int32), length=-1),
+            f"bad request fields: {e}",
+        )
+
+
+def _events_to_lines(events, completions, starts):
+    """Engine step output -> protocol JSONL strings. ``starts`` maps
+    request id -> primed positions, so done-events can report only the
+    generated suffix as text (parity with sample.py's print)."""
+    from progen_tpu.data.tokenizer import decode_tokens
+
+    lines = []
+    for ev in events:
+        lines.append(json.dumps({
+            "event": "token",
+            "id": ev.request_id,
+            "token": int(ev.token),
+            "text": decode_tokens([ev.token]),
+            "index": int(ev.index),
+        }))
+    for c in completions:
+        start = starts.pop(c.request_id, 0)
+        lines.append(json.dumps({
+            "event": "done",
+            "id": c.request_id,
+            "text": decode_tokens(c.tokens[start:]),
+            "n_generated": int(c.n_generated),
+            "ttft_s": round(c.ttft_s, 6),
+            "latency_s": round(c.latency_s, 6),
+        }))
+    return lines
+
+
+def _build(checkpoint_path, max_slots, max_len, max_queue):
+    from progen_tpu.checkpoint import get_checkpoint_fns
+    from progen_tpu.config import ProGenConfig
+    from progen_tpu.models.progen import ProGen
+    from progen_tpu.serving import Scheduler, ServeEngine
+
+    _, get_last, _ = get_checkpoint_fns(checkpoint_path)
+    pkg = get_last.restore_params()
+    if pkg is None:
+        sys.exit(f"no checkpoints found at {checkpoint_path}")
+    config = ProGenConfig.from_dict(pkg.model_config)
+    model = ProGen(config)
+    engine = ServeEngine(
+        model, pkg.state, max_slots=max_slots,
+        max_len=min(max_len or config.seq_len, config.seq_len),
+    )
+    return Scheduler(engine, max_queue=max_queue), engine
+
+
+@click.command()
+@click.option("--checkpoint_path", default="./ckpts")
+@click.option("--max-slots", default=8,
+              help="device decode lanes: concurrent requests advanced "
+                   "per step (fixes the compiled shapes)")
+@click.option("--max-queue", default=64,
+              help="bounded admission queue; submits beyond this are "
+                   "rejected with reason 'queue_full'")
+@click.option("--max-len", default=None, type=int,
+              help="longest servable sequence (default: the model's "
+                   "seq_len); also the per-request 'length' default")
+@click.option("--top_k", default=25, help="default per-request top_k")
+@click.option("--temperature", default=1.0,
+              help="default per-request temperature")
+@click.option("--top_p", default=None, type=float,
+              help="default per-request nucleus mass")
+@click.option("--seed", default=42, help="default per-request PRNG seed")
+@click.option("--socket", "socket_path", default=None, type=str,
+              help="serve a unix domain socket at PATH instead of "
+                   "stdin/stdout")
+@click.option("--metrics-every", default=0,
+              help="log a serve/ metrics snapshot to the tracker every "
+                   "N decode steps (0 = only at exit)")
+def main(checkpoint_path, max_slots, max_queue, max_len, top_k,
+         temperature, top_p, seed, socket_path, metrics_every):
+    from progen_tpu.tracking import make_tracker
+
+    sched, engine = _build(checkpoint_path, max_slots, max_len, max_queue)
+    defaults = {
+        "length": engine.max_len, "top_k": top_k,
+        "temperature": temperature, "top_p": top_p, "seed": seed,
+    }
+    tracker = make_tracker("progen-serve")
+    print(
+        f"serving: max_slots={engine.max_slots} max_len={engine.max_len} "
+        f"max_queue={sched.max_queue}",
+        file=sys.stderr,
+    )
+    try:
+        if socket_path:
+            _serve_socket(sched, defaults, socket_path, tracker,
+                          metrics_every)
+        else:
+            _serve_stdio(sched, defaults, tracker, metrics_every)
+    finally:
+        sched.metrics.log_to(tracker)
+        tracker.finish()
+
+
+def _submit_line(sched, line, defaults):
+    """Parse + submit one request line; returns (rejection_line | None,
+    request | None)."""
+    req, err = _parse_request(line, defaults)
+    if err is not None:
+        rid = req.id if req is not None else None
+        return json.dumps(
+            {"event": "rejected", "id": rid, "reason": err}
+        ), None
+    ok, reason = sched.submit(req)
+    if not ok:
+        return json.dumps(
+            {"event": "rejected", "id": req.id, "reason": reason}
+        ), None
+    return None, req
+
+
+def _serve_stdio(sched, defaults, tracker, metrics_every):
+    """stdin-JSONL transport: poll stdin between decode steps so new
+    requests join mid-flight (continuous batching, not read-all-then-
+    drain); EOF stops intake and the loop drains what remains."""
+    starts = {}
+    out = sys.stdout
+    eof = False
+    steps = 0
+
+    def emit(lines):
+        for ln in lines:
+            out.write(ln + "\n")
+        out.flush()
+
+    while not eof or sched.has_work:
+        # take every line already waiting; block for input only when idle
+        while not eof:
+            timeout = None if not sched.has_work else 0.0
+            ready, _, _ = select.select([sys.stdin], [], [], timeout)
+            if not ready:
+                break
+            line = sys.stdin.readline()
+            if not line:
+                eof = True
+                break
+            if not line.strip():
+                continue
+            rej, req = _submit_line(sched, line, defaults)
+            if rej is not None:
+                emit([rej])
+            else:
+                starts[req.id] = len(req.prime) + 1  # add_bos
+        if sched.has_work:
+            events, comps = sched.step()
+            emit(_events_to_lines(events, comps, starts))
+            steps += 1
+            if metrics_every and steps % metrics_every == 0:
+                sched.metrics.log_to(tracker, step=steps)
+
+
+def _serve_socket(sched, defaults, socket_path, tracker, metrics_every):
+    """Unix-socket transport: one select loop over {listener, clients,
+    engine}; request ids are namespaced per connection internally so two
+    clients may both call their request "1"."""
+    if os.path.exists(socket_path):
+        os.unlink(socket_path)
+    srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    srv.bind(socket_path)
+    srv.listen(16)
+    srv.setblocking(False)
+    clients = {}  # fd -> (sock, recv_buffer)
+    owners = {}  # internal request id -> fd
+    starts = {}
+    steps = 0
+    print(f"listening on {socket_path}", file=sys.stderr)
+
+    def send(fd, internal_lines):
+        sock, _ = clients.get(fd, (None, None))
+        if sock is None:
+            return
+        try:
+            for ln in internal_lines:
+                sock.sendall(ln.encode() + b"\n")
+        except OSError:
+            _drop(fd)
+
+    def _drop(fd):
+        sock, _ = clients.pop(fd, (None, None))
+        if sock is not None:
+            sock.close()
+
+    try:
+        while True:
+            rlist = [srv] + [s for s, _ in clients.values()]
+            timeout = 0.0 if sched.has_work else 0.2
+            ready, _, _ = select.select(rlist, [], [], timeout)
+            for sock in ready:
+                if sock is srv:
+                    conn, _ = srv.accept()
+                    conn.setblocking(False)
+                    clients[conn.fileno()] = (conn, b"")
+                    continue
+                fd = sock.fileno()
+                try:
+                    data = sock.recv(65536)
+                except OSError:
+                    data = b""
+                if not data:
+                    _drop(fd)
+                    continue
+                _, buf = clients[fd]
+                buf += data
+                *lines, buf = buf.split(b"\n")
+                clients[fd] = (sock, buf)
+                for raw in lines:
+                    if not raw.strip():
+                        continue
+                    line = raw.decode("utf-8", "replace")
+                    req, err = _parse_request(line, defaults)
+                    if req is not None and err is None:
+                        # namespace the id so clients can't collide
+                        public = req.id
+                        req.id = f"{fd}:{public}"
+                        ok, reason = sched.submit(req)
+                        if ok:
+                            owners[req.id] = (fd, public)
+                            starts[req.id] = len(req.prime) + 1
+                            continue
+                        err = reason
+                        public_id = public
+                    else:
+                        public_id = req.id if req is not None else None
+                    send(fd, [json.dumps({
+                        "event": "rejected", "id": public_id,
+                        "reason": err,
+                    })])
+            if sched.has_work:
+                events, comps = sched.step()
+                for ev in events:
+                    fd, public = owners.get(ev.request_id, (None, None))
+                    if fd is None:
+                        continue
+                    ev.request_id = public
+                    send(fd, _events_to_lines([ev], [], starts))
+                for c in comps:
+                    fd, public = owners.pop(c.request_id, (None, None))
+                    if fd is None:
+                        continue
+                    start = starts.pop(c.request_id, 0)
+                    c.request_id = public
+                    send(fd, _events_to_lines([], [c], {public: start}))
+                steps += 1
+                if metrics_every and steps % metrics_every == 0:
+                    sched.metrics.log_to(tracker, step=steps)
+    finally:
+        for fd in list(clients):
+            _drop(fd)
+        srv.close()
+        if os.path.exists(socket_path):
+            os.unlink(socket_path)
+
+
+if __name__ == "__main__":
+    main()

@@ -56,6 +56,20 @@ class UniformBlock(nn.Module):
         return x, None
 
 
+def decode_model(model: "ProGen") -> "ProGen":
+    """The decode-mode twin of a full-forward model: same weight tree
+    (scan-stacked layouts convert via ``unstack_params`` — decode is always
+    unrolled because its per-layer caches are), one token per call, state
+    in a flax 'cache' collection (rolling 2-window K/V ring, token-shift
+    states, SGU gate history, and a position counter — all allocated
+    batch-shaped by ``init``, which is the cache-shape hook the sampling
+    and serving layers build their buffers from)."""
+    import dataclasses
+
+    return ProGen(dataclasses.replace(model.config, decode=True),
+                  mesh=model.mesh)
+
+
 def unstack_params(params: dict, config: ProGenConfig) -> dict:
     """Convert a scan_layers param tree (stacked 'layers' subtree) to the
     unrolled attn{i}/ff{i} layout — needed by decode mode (per-layer caches

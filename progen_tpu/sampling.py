@@ -122,6 +122,49 @@ def _gumbel_topk_step(key, logit, top_k, parity=True, temperature=1.0,
     return key, jnp.argmax(logit + noise, axis=-1)
 
 
+def gumbel_step_dynamic(key, logit, top_k, parity, temperature, top_p):
+    """One Gumbel-max draw with EVERY knob a traced operand — the serving
+    engine's per-slot sampler. ``_gumbel_topk_step`` bakes top_k/parity in
+    at trace time (right for one decode, one setting); a continuously
+    batched engine holds requests with different settings in one compiled
+    program, so here ``top_k`` (int32, 0 = off), ``parity`` (bool) and the
+    float knobs all ride as data and both branches are computed then
+    selected. Bit-identical to ``_gumbel_topk_step`` for every setting
+    (pinned by tests/test_sampling.py::TestDynamicGumbelStep): the k-th
+    value from a descending sort equals ``top_k(...).min()``, so the
+    strict-> masks match float-for-float, and the knob branch re-derives
+    its threshold from the TEMPERED logits exactly as select_top_k does
+    (dividing the untempered threshold could round differently).
+    Vmappable; returns (new_key, sampled_id)."""
+    key, sub = jax.random.split(key)
+    noise = gumbel_noise(sub, logit.shape)
+    v = logit.shape[-1]
+    kc = jnp.clip(top_k, 1, v) - 1
+    k_on = top_k > 0
+
+    # reference-parity branch (zeroing quirk preserved, as in the static
+    # sampler's parity path; top_k off => no masking at all)
+    kth = jax.lax.dynamic_index_in_dim(
+        -jnp.sort(-logit, axis=-1), kc, axis=-1, keepdims=False
+    )
+    mask_p = (logit > kth) | ~k_on
+    pick_parity = jnp.argmax(
+        jnp.where(mask_p, logit, 0.0) + jnp.where(mask_p, noise, 0.0),
+        axis=-1,
+    )
+
+    # knob branch (finfo.min masking — see _gumbel_topk_step's rationale)
+    lt = logit / temperature
+    kth_t = jax.lax.dynamic_index_in_dim(
+        -jnp.sort(-lt, axis=-1), kc, axis=-1, keepdims=False
+    )
+    mask = select_top_p(lt, top_p) & ((lt > kth_t) | ~k_on)
+    pick_knobs = jnp.argmax(
+        jnp.where(mask, lt, jnp.finfo(lt.dtype).min) + noise, axis=-1
+    )
+    return key, jnp.where(parity, pick_parity, pick_knobs)
+
+
 def _prepare_seq(model, prime, length, add_bos):
     """Validate and build the fixed-shape decode buffer (shared by ALL
     decode paths): BOS shift (utils.py:110-111), right-padding, and the
@@ -318,11 +361,9 @@ def _decode_setup(model, params, batch: int):
     init (params creation inside init is dead-code-eliminated since only
     the cache collection is returned), replicated on the params' mesh —
     see _cache_init_fn."""
-    import dataclasses
+    from progen_tpu.models.progen import decode_model, unstack_params
 
-    from progen_tpu.models.progen import ProGen, unstack_params
-
-    dec_model = ProGen(dataclasses.replace(model.config, decode=True))
+    dec_model = decode_model(model)
     if model.config.scan_layers:
         # decode mode is always unrolled (per-layer caches); convert the
         # scanned stacked layout

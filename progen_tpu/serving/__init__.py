@@ -1,0 +1,32 @@
+"""Continuous-batching serving: slot-pool engine + FIFO scheduler.
+
+Orca-style iteration-level scheduling over a fixed slot pool; see
+engine.py for the design. Typical use:
+
+    engine = ServeEngine(model, params, max_slots=8, max_len=512)
+    sched = Scheduler(engine, max_queue=64)
+    ok, reason = sched.submit(Request(id="r0", prime=toks, length=128))
+    while sched.has_work:
+        events, completions = sched.step()
+"""
+
+from progen_tpu.serving.engine import ServeEngine, SlotBatch
+from progen_tpu.serving.metrics import ServingMetrics
+from progen_tpu.serving.scheduler import (
+    REJECT_QUEUE_FULL,
+    Completion,
+    Request,
+    Scheduler,
+    TokenEvent,
+)
+
+__all__ = [
+    "ServeEngine",
+    "SlotBatch",
+    "ServingMetrics",
+    "Scheduler",
+    "Request",
+    "TokenEvent",
+    "Completion",
+    "REJECT_QUEUE_FULL",
+]

@@ -1,0 +1,332 @@
+"""Slot-pool continuous-batching engine over the incremental decoder.
+
+The single-shot decoders in sampling.py compile one program per (batch,
+length) and run it to completion — fine for a training cadence, wasteful
+for serving, where requests arrive and finish at different times. This
+engine keeps a fixed pool of ``max_slots`` decode lanes resident on the
+device (the slot-pool idea of vLLM/PagedAttention, SOSP '23, at
+granularity one-slot-one-request) and advances EVERY live lane one token
+per ``decode_step`` call (the iteration-level scheduling of Orca,
+OSDI '22). All shapes are functions of (max_slots, max_len) only, so an
+engine's whole lifetime re-executes exactly two compiled programs:
+one prefill, one decode step.
+
+Per-slot positions without touching the model: decode mode keeps a
+single scalar ``pos`` cache counter (progen.py), which a batch-B cache
+shares across rows — useless when rows start and finish at different
+times. Instead the pool stacks ``max_slots`` BATCH-1 cache trees along
+a leading slot axis and the decode step ``vmap``s the one-token apply
+over it, so every slot carries its own scalar ``pos`` (and its own ring
+indices, shift states, and gate history). Dead slots keep computing —
+static shapes are the point — on garbage caches; that is safe because
+``prefill`` rewrites the slot's entire cache tree from a fresh zeroed
+template (NOT by zeroing in place: ``slot_pos`` initialises to -1)
+before the slot is ever read again.
+
+Sampling params ride as per-slot DATA (gumbel_step_dynamic), so one
+compiled step serves any mix of temperature/top_k/top_p. Each slot
+follows the standalone per-request PRNG stream: a request decoded here
+is bit-identical to ``sample_fast(key=request_key, ...)`` — pinned by
+tests/test_serving.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from progen_tpu.sampling import (
+    _TOP_P_OFF,
+    _decode_setup,
+    _prepare_seq,
+    _validate_knobs,
+    gumbel_step_dynamic,
+)
+
+
+class SlotBatch(NamedTuple):
+    """Device-resident pooled state; every leaf's leading axis is
+    ``max_slots``. A pytree, so it moves through jit/vmap whole."""
+
+    cache: dict  # model cache, leaves (S, *batch1_leaf_shape)
+    seqs: jnp.ndarray  # (S, L) int32 token buffers (right-padded with 0)
+    cur: jnp.ndarray  # (S,) int32 position of the last written token
+    keys: jnp.ndarray  # (S, ...) per-slot PRNG keys
+    nz: jnp.ndarray  # (S,) int32 zero-token count (BOS first, EOS second)
+    target: jnp.ndarray  # (S,) int32 requested total length
+    temp: jnp.ndarray  # (S,) f32 temperature
+    top_p: jnp.ndarray  # (S,) f32 nucleus mass (_TOP_P_OFF = off)
+    top_k: jnp.ndarray  # (S,) int32 (0 = off)
+    parity: jnp.ndarray  # (S,) bool reference-quirk sampling branch
+    live: jnp.ndarray  # (S,) bool slot is decoding
+
+
+@functools.partial(jax.jit, static_argnames=("model",))
+def _prefill(
+    model,
+    params,
+    slots: SlotBatch,
+    fresh_cache,
+    slot,
+    tokens,
+    start,
+    target,
+    key,
+    temp,
+    top_p,
+    top_k,
+    parity,
+):
+    """Admit one request into ``slot``: run the prime through a FRESH
+    batch-1 cache (positions 0..start-2; a dynamic-bound fori_loop, so
+    one compile serves every prime length) and scatter the cache + all
+    per-slot state into the pool. ``slot``/``start``/``target`` are
+    traced, keeping this a single compiled program."""
+    length = slots.seqs.shape[1]
+
+    def feed(p, cache):
+        tok = jax.lax.dynamic_slice(tokens, (p,), (1,))[None]
+        _, mut = model.apply(
+            {"params": params, "cache": cache}, tok, mutable=["cache"]
+        )
+        return mut["cache"]
+
+    cache1 = jax.lax.fori_loop(0, start - 1, feed, fresh_cache)
+    cache = jax.tree.map(
+        lambda pool, c: jax.lax.dynamic_update_index_in_dim(
+            pool, c, slot, axis=0
+        ),
+        slots.cache,
+        cache1,
+    )
+    # zeros already present in the primed region count toward the
+    # stop-at-second-zero rule (same cumsum the standalone decoders apply)
+    nz0 = jnp.sum(
+        ((tokens == 0) & (jnp.arange(length) < start)).astype(jnp.int32)
+    )
+    return SlotBatch(
+        cache=cache,
+        seqs=jax.lax.dynamic_update_index_in_dim(
+            slots.seqs, tokens, slot, axis=0
+        ),
+        cur=slots.cur.at[slot].set(start - 1),
+        keys=slots.keys.at[slot].set(key),
+        nz=slots.nz.at[slot].set(nz0),
+        target=slots.target.at[slot].set(target),
+        temp=slots.temp.at[slot].set(temp),
+        top_p=slots.top_p.at[slot].set(top_p),
+        top_k=slots.top_k.at[slot].set(top_k),
+        parity=slots.parity.at[slot].set(parity),
+        live=slots.live.at[slot].set(True),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("model",))
+def _decode_step(model, params, slots: SlotBatch):
+    """Advance ALL slots one token: vmapped batch-1 apply over the slot
+    axis, per-slot dynamic Gumbel draw, masked scatter-back. Dead slots
+    compute too (their writes are masked out) — the price of a single
+    static-shape program, and exactly what keeps a TPU from recompiling
+    as traffic churns. Returns (new_slots, sampled, was_live, finished);
+    ``finished`` flags slots that JUST hit EOS (second zero) or their
+    requested length this step."""
+    n_slots, length = slots.seqs.shape
+    pos = jnp.clip(slots.cur, 0, length - 1)
+    toks = jnp.take_along_axis(slots.seqs, pos[:, None], axis=1)[:, :, None]
+
+    def one(cache, tok):
+        logits, mut = model.apply(
+            {"params": params, "cache": cache}, tok, mutable=["cache"]
+        )
+        return logits[0, 0], mut["cache"]
+
+    logits, cache = jax.vmap(one)(slots.cache, toks)
+    keys, sampled = jax.vmap(gumbel_step_dynamic)(
+        slots.keys, logits, slots.top_k, slots.parity, slots.temp,
+        slots.top_p,
+    )
+    sampled = sampled.astype(slots.seqs.dtype)
+    wpos = jnp.clip(slots.cur + 1, 0, length - 1)
+    written = slots.seqs.at[jnp.arange(n_slots), wpos].set(sampled)
+    seqs = jnp.where(slots.live[:, None], written, slots.seqs)
+    nz = slots.nz + ((sampled == 0) & slots.live).astype(jnp.int32)
+    cur = jnp.where(slots.live, slots.cur + 1, slots.cur)
+    finished = slots.live & ((nz >= 2) | (cur >= slots.target - 1))
+    new = SlotBatch(
+        cache=cache,
+        seqs=seqs,
+        cur=cur,
+        keys=keys,
+        nz=nz,
+        target=slots.target,
+        temp=slots.temp,
+        top_p=slots.top_p,
+        top_k=slots.top_k,
+        parity=slots.parity,
+        live=slots.live & ~finished,
+    )
+    return new, sampled, slots.live, finished
+
+
+class ServeEngine:
+    """Fixed-pool continuous-batching engine bound to one (model, params,
+    max_slots, max_len). Host-side it is just a free-list and two jitted
+    calls; all decode state lives on the device in ``self.slots``."""
+
+    def __init__(self, model, params, *, max_slots: int = 8,
+                 max_len: Optional[int] = None):
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        self.max_len = int(max_len or model.config.seq_len)
+        if not 2 <= self.max_len <= model.config.seq_len:
+            raise ValueError(
+                f"max_len must be in [2, seq_len={model.config.seq_len}], "
+                f"got {self.max_len}"
+            )
+        self.max_slots = int(max_slots)
+        self.model, self.params, self.fresh_cache = _decode_setup(
+            model, params, batch=1
+        )
+        s, l = self.max_slots, self.max_len
+        key0 = jax.random.PRNGKey(0)
+        self.slots = SlotBatch(
+            cache=jax.tree.map(
+                lambda c: jnp.broadcast_to(c[None], (s,) + c.shape).copy(),
+                self.fresh_cache,
+            ),
+            seqs=jnp.zeros((s, l), jnp.int32),
+            cur=jnp.zeros((s,), jnp.int32),
+            keys=jnp.broadcast_to(
+                key0[None], (s,) + key0.shape
+            ).copy(),
+            nz=jnp.zeros((s,), jnp.int32),
+            target=jnp.full((s,), l, jnp.int32),
+            temp=jnp.ones((s,), jnp.float32),
+            top_p=jnp.full((s,), _TOP_P_OFF, jnp.float32),
+            top_k=jnp.zeros((s,), jnp.int32),
+            parity=jnp.ones((s,), bool),
+            live=jnp.zeros((s,), bool),
+        )
+        self._free = list(range(s))
+        self._targets = [l] * s  # host mirror for collect()
+
+    # ----- slot lifecycle -------------------------------------------------
+
+    @property
+    def num_active(self) -> int:
+        return self.max_slots - len(self._free)
+
+    @property
+    def any_live(self) -> bool:
+        return len(self._free) < self.max_slots
+
+    def acquire(self) -> Optional[int]:
+        """Claim the lowest free slot (deterministic assignment), or None
+        when the pool is saturated."""
+        if not self._free:
+            return None
+        self._free.sort()
+        return self._free.pop(0)
+
+    def release(self, slot: int) -> None:
+        """Return a finished (or cancelled) slot to the free list. Device
+        state is NOT scrubbed — the next prefill fully rewrites it; a
+        cancelled still-live slot is silenced so it stops burning steps."""
+        if slot in self._free:
+            return
+        if bool(self.slots.live[slot]):
+            self.slots = self.slots._replace(
+                live=self.slots.live.at[slot].set(False)
+            )
+        self._free.append(slot)
+
+    # ----- request admission ---------------------------------------------
+
+    def validate(self, prime, length, *, add_bos: bool = False,
+                 temperature: float = 1.0, top_p=None, top_k=25) -> None:
+        """Raise ValueError for anything the pool cannot serve — the same
+        checks the standalone decoders apply, plus the pool's max_len
+        bound and the dynamic sampler's top_k range. Cheap (no device
+        work beyond the prime copy); the scheduler rejects on this at
+        submit time so invalid requests never occupy queue space."""
+        if length > self.max_len:
+            raise ValueError(
+                f"length {length} exceeds engine max_len {self.max_len}"
+            )
+        _validate_knobs(temperature, top_p)
+        if top_k is not None and not (
+            1 <= int(top_k) <= self.model.config.num_tokens
+        ):
+            raise ValueError(
+                f"top_k must be None or in [1, {self.model.config.num_tokens}]"
+                f", got {top_k}"
+            )
+        _prepare_seq(self.model, prime, length, add_bos)
+
+    def prefill(self, slot: int, prime, length: int, *,
+                top_k=25, add_bos: bool = False, temperature: float = 1.0,
+                top_p=None, key=None, seed: int = 0) -> int:
+        """Admit a request into ``slot``. Returns the number of primed
+        positions (``start``). The slot's stream is bit-identical to
+        ``sample_fast(key, model, params, prime, length, ...)``."""
+        self.validate(prime, length, add_bos=add_bos,
+                      temperature=temperature, top_p=top_p, top_k=top_k)
+        seq, start = _prepare_seq(self.model, prime, length, add_bos)
+        row = np.zeros((self.max_len,), np.int32)
+        row[: int(seq.shape[0])] = np.asarray(seq)
+        if key is None:
+            key = jax.random.PRNGKey(seed)
+        parity = temperature == 1.0 and top_p is None
+        self.slots = _prefill(
+            self.model, self.params, self.slots, self.fresh_cache,
+            jnp.int32(slot), jnp.asarray(row), jnp.int32(start),
+            jnp.int32(length), key,
+            jnp.float32(temperature),
+            jnp.float32(_TOP_P_OFF if top_p is None else top_p),
+            jnp.int32(0 if top_k is None else top_k),
+            jnp.asarray(parity),
+        )
+        self._targets[slot] = int(length)
+        return int(start)
+
+    # ----- the hot loop ---------------------------------------------------
+
+    def decode_step(self):
+        """One token for every live slot. Returns host arrays
+        (sampled, was_live, finished), each (max_slots,) — ``sampled[i]``
+        is meaningful only where ``was_live[i]``."""
+        self.slots, sampled, was_live, finished = _decode_step(
+            self.model, self.params, self.slots
+        )
+        return (
+            np.asarray(sampled),
+            np.asarray(was_live),
+            np.asarray(finished),
+        )
+
+    def collect(self, slot: int) -> np.ndarray:
+        """The finished request's (target,) token buffer with the
+        standalone decoders' truncation applied (everything after the
+        second zero -> 0), so it compares token-for-token with
+        ``sample_fast`` output."""
+        row = np.asarray(self.slots.seqs[slot])[: self._targets[slot]]
+        row = row.copy()
+        row[np.cumsum(row == 0) > 1] = 0
+        return row
+
+    # ----- introspection --------------------------------------------------
+
+    @staticmethod
+    def decode_compile_count() -> int:
+        """Number of compiled variants of the decode step across ALL
+        engines in the process — the jit-cache-miss counter the
+        compile-once acceptance test asserts on."""
+        return _decode_step._cache_size()
+
+    @staticmethod
+    def prefill_compile_count() -> int:
+        return _prefill._cache_size()

@@ -1,0 +1,100 @@
+"""Serving metrics: counters, gauges, and timing observations.
+
+Deliberately dependency-free and single-threaded (the scheduler owns
+the loop); the only integration point is ``log_to(tracker)``, which
+flattens a snapshot into the wandb-compatible ``tracking.py`` interface
+under a ``serve/`` prefix — so serving runs land in the same
+metrics.jsonl / wandb stream as training runs.
+
+Throughput is derived, not sampled: the scheduler accumulates exact
+token counts and wall-clock time around the prefill/decode calls, and
+``snapshot()`` divides. That makes decode_tokens_per_s a true
+steady-state number (tokens that actually advanced / time the device
+actually spent), not a gauge that depends on when you look.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional
+
+
+class _Timing:
+    """Running sum/count/min/max for an observed duration."""
+
+    __slots__ = ("sum", "count", "min", "max")
+
+    def __init__(self):
+        self.sum = 0.0
+        self.count = 0
+        self.min = math.inf
+        self.max = 0.0
+
+    def observe(self, v: float) -> None:
+        self.sum += v
+        self.count += 1
+        self.min = min(self.min, v)
+        self.max = max(self.max, v)
+
+    def stats(self) -> Dict[str, float]:
+        mean = self.sum / self.count if self.count else 0.0
+        return {
+            "mean_s": mean,
+            "max_s": self.max,
+            "min_s": self.min if self.count else 0.0,
+            "count": float(self.count),
+        }
+
+
+class ServingMetrics:
+    """Counters (monotonic), gauges (last value), timings (running
+    stats), and time accumulators (for derived throughput)."""
+
+    def __init__(self):
+        self.counters: Dict[str, int] = {}
+        self.gauges: Dict[str, float] = {}
+        self._timings: Dict[str, _Timing] = {}
+        self._times: Dict[str, float] = {}
+
+    def inc(self, name: str, by: int = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + by
+
+    def set_gauge(self, name: str, value: float) -> None:
+        self.gauges[name] = float(value)
+
+    def observe(self, name: str, seconds: float) -> None:
+        self._timings.setdefault(name, _Timing()).observe(seconds)
+
+    def add_time(self, name: str, seconds: float) -> None:
+        self._times[name] = self._times.get(name, 0.0) + seconds
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flat dict of everything, plus derived tokens/s rates. Keys are
+        stable, so jsonl consumers can grep a run end-to-end."""
+        out: Dict[str, float] = {}
+        for k, v in self.counters.items():
+            out[k] = float(v)
+        out.update(self.gauges)
+        for k, v in self._times.items():
+            out[k] = v
+        for name, t in self._timings.items():
+            for stat, v in t.stats().items():
+                out[f"{name}_{stat}"] = v
+        decode_t = self._times.get("decode_time_s", 0.0)
+        if decode_t > 0:
+            out["decode_tokens_per_s"] = (
+                self.counters.get("decode_tokens", 0) / decode_t
+            )
+        prefill_t = self._times.get("prefill_time_s", 0.0)
+        if prefill_t > 0:
+            out["prefill_tokens_per_s"] = (
+                self.counters.get("prefill_tokens", 0) / prefill_t
+            )
+        return out
+
+    def log_to(self, tracker, step: Optional[int] = None) -> None:
+        """Emit the snapshot through a tracking.py tracker (Jsonl/wandb/
+        Noop all share the ``log(dict, step)`` shape)."""
+        tracker.log(
+            {f"serve/{k}": v for k, v in self.snapshot().items()}, step
+        )

@@ -1,0 +1,227 @@
+"""FIFO admission scheduling for the slot-pool engine.
+
+Continuous batching in the Orca (OSDI '22) sense: admission happens at
+token-iteration granularity — every ``step()`` first drains the FIFO
+queue into whatever slots just freed, then advances all live slots one
+token. A finished request's slot is back in rotation on the very next
+step, so the pool stays saturated as long as the queue is non-empty.
+
+Backpressure is explicit: the queue is bounded and ``submit`` answers
+(accepted, reason) instead of blocking — a serving front-end must know
+*why* it should shed load ("queue_full") versus bounce a bad request
+("invalid: ..."). Invalid requests are rejected at submit time (engine
+validation, no device work) so they never occupy queue space.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from progen_tpu.serving.engine import ServeEngine
+from progen_tpu.serving.metrics import ServingMetrics
+
+REJECT_QUEUE_FULL = "queue_full"
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request. ``seed`` derives the PRNG key unless an
+    explicit ``key`` is given; either way the response is bit-identical
+    to ``sample_fast`` with that key on this prime."""
+
+    id: str
+    prime: object  # 1-D int token ids
+    length: int
+    top_k: Optional[int] = 25
+    add_bos: bool = False
+    temperature: float = 1.0
+    top_p: Optional[float] = None
+    seed: int = 0
+    key: object = None
+
+
+@dataclasses.dataclass
+class TokenEvent:
+    """One streamed token: emitted by ``step()`` the moment the slot's
+    decode step produced it."""
+
+    request_id: str
+    token: int
+    index: int  # position in the (length,) output buffer
+    done: bool
+
+
+@dataclasses.dataclass
+class Completion:
+    request_id: str
+    tokens: np.ndarray  # (length,) truncated like the standalone decoders
+    n_generated: int
+    ttft_s: float
+    latency_s: float
+
+
+@dataclasses.dataclass
+class _Active:
+    req: Request
+    slot: int
+    start: int  # primed positions; first generated token lands at ``start``
+    t_submit: float
+    t_admit: float
+    first_token_t: Optional[float] = None
+    n_generated: int = 0
+
+
+class Scheduler:
+    """Bounded-FIFO front of a ServeEngine. Single-threaded by design:
+    the caller owns the loop and calls ``step()`` until ``has_work`` is
+    False (or forever, in a server)."""
+
+    def __init__(self, engine: ServeEngine, *, max_queue: int = 64,
+                 metrics: Optional[ServingMetrics] = None,
+                 clock: Callable[[], float] = time.perf_counter):
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.engine = engine
+        self.max_queue = int(max_queue)
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        self._clock = clock
+        self._queue: deque[Tuple[Request, float]] = deque()
+        self._active: dict[int, _Active] = {}
+
+    # ----- intake ---------------------------------------------------------
+
+    def submit(self, req: Request) -> Tuple[bool, Optional[str]]:
+        """(accepted, reason). ``reason`` is None on accept,
+        ``"queue_full"`` under backpressure, or ``"invalid: ..."`` when
+        the engine can never serve the request."""
+        self.metrics.inc("requests_submitted")
+        try:
+            self.engine.validate(
+                req.prime, req.length, add_bos=req.add_bos,
+                temperature=req.temperature, top_p=req.top_p,
+                top_k=req.top_k,
+            )
+        except ValueError as e:
+            self.metrics.inc("requests_rejected")
+            self.metrics.inc("rejected_invalid")
+            return False, f"invalid: {e}"
+        if len(self._queue) >= self.max_queue:
+            self.metrics.inc("requests_rejected")
+            self.metrics.inc("rejected_queue_full")
+            return False, REJECT_QUEUE_FULL
+        self._queue.append((req, self._clock()))
+        self.metrics.set_gauge("queue_depth", len(self._queue))
+        return True, None
+
+    # ----- the loop -------------------------------------------------------
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self._queue) or bool(self._active)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    @property
+    def active_ids(self) -> List[str]:
+        return [a.req.id for a in self._active.values()]
+
+    def _admit(self) -> None:
+        while self._queue:
+            slot = self.engine.acquire()
+            if slot is None:
+                break
+            req, t_submit = self._queue.popleft()
+            t0 = self._clock()
+            start = self.engine.prefill(
+                slot, req.prime, req.length, top_k=req.top_k,
+                add_bos=req.add_bos, temperature=req.temperature,
+                top_p=req.top_p, key=req.key, seed=req.seed,
+            )
+            t1 = self._clock()
+            self._active[slot] = _Active(req, slot, start, t_submit, t1)
+            self.metrics.inc("requests_admitted")
+            # start-1 prime tokens actually ran through the model
+            self.metrics.inc("prefill_tokens", max(start - 1, 0))
+            self.metrics.add_time("prefill_time_s", t1 - t0)
+        self.metrics.set_gauge("queue_depth", len(self._queue))
+        self.metrics.set_gauge("active_slots", len(self._active))
+
+    def step(self) -> Tuple[List[TokenEvent], List[Completion]]:
+        """Admit what fits, then advance every live slot one token.
+        Returns the tokens produced this step (streaming order =
+        slot order, stable) and any requests that finished."""
+        self._admit()
+        if not self._active:
+            return [], []
+        t0 = self._clock()
+        sampled, was_live, finished = self.engine.decode_step()
+        t1 = self._clock()
+        now = t1
+        events: List[TokenEvent] = []
+        completions: List[Completion] = []
+        n_live = 0
+        for slot in sorted(self._active):
+            rec = self._active[slot]
+            if not was_live[slot]:
+                continue
+            n_live += 1
+            rec.n_generated += 1
+            if rec.first_token_t is None:
+                rec.first_token_t = now
+                self.metrics.observe("ttft_s", now - rec.t_submit)
+            done = bool(finished[slot])
+            events.append(
+                TokenEvent(
+                    rec.req.id,
+                    int(sampled[slot]),
+                    rec.start + rec.n_generated - 1,
+                    done,
+                )
+            )
+            if done:
+                completions.append(self._finish(slot, rec, now))
+        self.metrics.inc("decode_steps")
+        self.metrics.inc("decode_tokens", n_live)
+        self.metrics.add_time("decode_time_s", t1 - t0)
+        self.metrics.set_gauge("active_slots", len(self._active))
+        return events, completions
+
+    def _finish(self, slot: int, rec: _Active, now: float) -> Completion:
+        tokens = self.engine.collect(slot)
+        self.engine.release(slot)
+        del self._active[slot]
+        self.metrics.inc("requests_completed")
+        self.metrics.observe("latency_s", now - rec.t_submit)
+        return Completion(
+            request_id=rec.req.id,
+            tokens=tokens,
+            n_generated=rec.n_generated,
+            ttft_s=(rec.first_token_t or now) - rec.t_submit,
+            latency_s=now - rec.t_submit,
+        )
+
+    def run_to_completion(self, max_steps: Optional[int] = None):
+        """Drain queue + slots; convenience for tests and the bench.
+        Returns (all events, all completions) in production order."""
+        events: List[TokenEvent] = []
+        completions: List[Completion] = []
+        steps = 0
+        while self.has_work:
+            if max_steps is not None and steps >= max_steps:
+                raise RuntimeError(
+                    f"run_to_completion exceeded {max_steps} steps with "
+                    f"work remaining (queue={len(self._queue)}, "
+                    f"active={len(self._active)})"
+                )
+            ev, comp = self.step()
+            events.extend(ev)
+            completions.extend(comp)
+            steps += 1
+        return events, completions

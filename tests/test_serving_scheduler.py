@@ -1,0 +1,203 @@
+"""Scheduler behavior: admission, backpressure, slot lifecycle, metrics.
+
+These tests exercise the control plane — FIFO order, bounded-queue
+rejection with machine-readable reasons, EOS/max-length slot release
+under mixed-length concurrent traffic — and the metrics surface the
+ops side depends on. Token-level correctness lives in test_serving.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from progen_tpu.config import ProGenConfig
+from progen_tpu.models.progen import ProGen
+from progen_tpu.serving import (
+    REJECT_QUEUE_FULL,
+    Request,
+    Scheduler,
+    ServeEngine,
+    ServingMetrics,
+)
+
+TINY = ProGenConfig(
+    num_tokens=32,
+    dim=32,
+    seq_len=32,
+    depth=2,
+    window_size=8,
+    global_mlp_depth=1,
+    heads=2,
+    dim_head=16,
+    ff_mult=2,
+    dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = ProGen(TINY)
+    tokens = jnp.zeros((1, TINY.seq_len), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), tokens)
+    from flax.core import meta
+
+    return model, meta.unbox(variables)["params"]
+
+
+def _req(i, length=10, **knobs):
+    return Request(
+        id=f"q{i}", prime=np.array([1 + i % 30, 2]), length=length,
+        key=jax.random.PRNGKey(i), **knobs,
+    )
+
+
+class TestBackpressure:
+    def test_bounded_queue_rejects_with_reason(self, model_and_params):
+        model, params = model_and_params
+        engine = ServeEngine(model, params, max_slots=1, max_len=32)
+        sched = Scheduler(engine, max_queue=2)
+        # nothing admitted yet (admission happens inside step), so the
+        # queue alone absorbs exactly max_queue submissions
+        ok0, r0 = sched.submit(_req(0))
+        ok1, r1 = sched.submit(_req(1))
+        assert (ok0, r0) == (True, None) and (ok1, r1) == (True, None)
+        ok2, r2 = sched.submit(_req(2))
+        assert not ok2 and r2 == REJECT_QUEUE_FULL
+        m = sched.metrics.snapshot()
+        assert m["rejected_queue_full"] == 1
+        assert m["requests_rejected"] == 1
+        assert m["queue_depth"] == 2
+        # a slot frees after completion -> the queue drains -> accepted
+        sched.run_to_completion(max_steps=300)
+        ok3, r3 = sched.submit(_req(3))
+        assert ok3 and r3 is None
+
+    def test_invalid_rejected_before_queueing(self, model_and_params):
+        model, params = model_and_params
+        engine = ServeEngine(model, params, max_slots=1, max_len=16)
+        sched = Scheduler(engine, max_queue=1)
+        for bad, why in [
+            (_req(0, length=17), "exceeds engine max_len"),
+            (_req(1, length=2), "must be <"),  # prime >= length
+            (Request(id="t", prime=np.array([1]), length=8,
+                     temperature=0.0, key=jax.random.PRNGKey(0)),
+             "temperature"),
+            (Request(id="p", prime=np.array([1]), length=8, top_p=1.5,
+                     key=jax.random.PRNGKey(0)), "top_p"),
+            (Request(id="k", prime=np.array([1]), length=8, top_k=99,
+                     key=jax.random.PRNGKey(0)), "top_k"),
+        ]:
+            ok, reason = sched.submit(bad)
+            assert not ok and reason.startswith("invalid:") and why in reason
+        # none of the invalid submissions consumed queue space
+        assert sched.queue_depth == 0
+        assert sched.metrics.snapshot()["rejected_invalid"] == 5
+
+    def test_fifo_admission_order(self, model_and_params):
+        model, params = model_and_params
+        engine = ServeEngine(model, params, max_slots=1, max_len=32)
+        sched = Scheduler(engine, max_queue=8)
+        for i in range(3):
+            assert sched.submit(_req(i, length=6))[0]
+        _, comps = sched.run_to_completion(max_steps=300)
+        assert [c.request_id for c in comps] == ["q0", "q1", "q2"]
+
+
+class TestSlotLifecycle:
+    def test_mixed_length_release_and_reuse(self, model_and_params):
+        """6 requests with very different lengths through 2 slots: every
+        completion frees a slot for the next admission (EOS or
+        max-length, whichever fires), active count never exceeds the
+        pool, and the pool is empty at drain."""
+        model, params = model_and_params
+        engine = ServeEngine(model, params, max_slots=2, max_len=32)
+        sched = Scheduler(engine, max_queue=8)
+        lengths = [5, 28, 9, 20, 6, 14]
+        for i, ln in enumerate(lengths):
+            assert sched.submit(_req(i, length=ln))[0]
+        completions = []
+        while sched.has_work:
+            assert engine.num_active <= 2
+            assert len(sched.active_ids) <= 2
+            _, comp = sched.step()
+            completions.extend(comp)
+        assert len(completions) == len(lengths)
+        assert engine.num_active == 0
+        assert sched.queue_depth == 0
+        # short requests must not be blocked behind long ones forever:
+        # q0 (len 5) finishes before q1 (len 28)
+        order = [c.request_id for c in completions]
+        assert order.index("q0") < order.index("q1")
+
+    def test_release_is_idempotent_and_engine_reusable(
+        self, model_and_params
+    ):
+        model, params = model_and_params
+        engine = ServeEngine(model, params, max_slots=2, max_len=32)
+        slot = engine.acquire()
+        engine.prefill(slot, np.array([3, 4]), 8,
+                       key=jax.random.PRNGKey(1))
+        engine.release(slot)
+        engine.release(slot)  # double-release must not corrupt the pool
+        assert engine.num_active == 0
+        assert sorted([engine.acquire(), engine.acquire()]) == [0, 1]
+        assert engine.acquire() is None  # saturated pool
+
+    def test_engine_rejects_bad_construction(self, model_and_params):
+        model, params = model_and_params
+        with pytest.raises(ValueError):
+            ServeEngine(model, params, max_slots=0)
+        with pytest.raises(ValueError):
+            ServeEngine(model, params, max_slots=1,
+                        max_len=TINY.seq_len + 1)
+
+
+class TestMetrics:
+    def test_counters_gauges_and_throughput(self, model_and_params):
+        model, params = model_and_params
+        engine = ServeEngine(model, params, max_slots=2, max_len=32)
+        metrics = ServingMetrics()
+        sched = Scheduler(engine, max_queue=2, metrics=metrics)
+        for i in range(2):
+            assert sched.submit(_req(i, length=8))[0]
+        sched.submit(_req(2, length=8))  # queue_full
+        sched.run_to_completion(max_steps=300)
+        m = metrics.snapshot()
+        assert m["requests_submitted"] == 3
+        assert m["requests_admitted"] == 2
+        assert m["requests_completed"] == 2
+        assert m["requests_rejected"] == 1
+        assert m["queue_depth"] == 0 and m["active_slots"] == 0
+        # prefill feeds start-1 positions (the last primed token is
+        # consumed by the first decode step): 1 per request here
+        assert m["prefill_tokens"] == 2.0
+        assert m["decode_tokens"] > 0
+        assert m["ttft_s_count"] == 2 and m["ttft_s_mean_s"] > 0
+        assert m["latency_s_count"] == 2
+        assert m["latency_s_max_s"] >= m["ttft_s_mean_s"] > 0
+        assert m["decode_tokens_per_s"] > 0
+        assert m["prefill_tokens_per_s"] > 0
+
+    def test_log_to_tracker(self, model_and_params, tmp_path):
+        from progen_tpu.tracking import JsonlTracker
+
+        model, params = model_and_params
+        engine = ServeEngine(model, params, max_slots=1, max_len=32)
+        sched = Scheduler(engine, max_queue=2)
+        assert sched.submit(_req(0, length=6))[0]
+        sched.run_to_completion(max_steps=100)
+        tracker = JsonlTracker("serve-test", None, str(tmp_path))
+        sched.metrics.log_to(tracker, step=1)
+        tracker.finish()
+        import json
+
+        line = (
+            (tmp_path / "serve-test" / tracker.run_id / "metrics.jsonl")
+            .read_text()
+            .strip()
+        )
+        rec = json.loads(line)
+        assert rec["serve/requests_completed"] == 1.0
+        assert rec["_step"] == 1
+        assert "serve/decode_tokens_per_s" in rec
