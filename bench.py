@@ -69,12 +69,19 @@ _DETAIL_PATH = _REPO / "BENCH_DETAIL.json"
 _LOG_DIR = _REPO / "runs" / "bench_logs"
 
 
+_WATCHDOG = None  # phase-child stall watchdog; beaten by _mark
+
+
 def _mark(msg: str) -> None:
     """Progress marker on stderr (streamed to the phase log by the
     orchestrator): when a phase is timeout-killed, the trail shows how far
-    it got — init, compile, or iteration N."""
+    it got — init, compile, or iteration N. Doubles as the stall-watchdog
+    heartbeat in phase children, so "marks stopped" is exactly the
+    condition that triggers a stack dump."""
     print(f"[bench-mark +{time.perf_counter() - _T0:.1f}s] {msg}",
           file=sys.stderr, flush=True)
+    if _WATCHDOG is not None:
+        _WATCHDOG.beat()
 
 
 _T0 = time.perf_counter()
@@ -106,15 +113,17 @@ def _value_fence(out) -> None:
 def _hbm_stats() -> dict:
     """Per-device memory stats where the backend exposes them (TPU does;
     CPU returns nothing) — peak HBM in use is the per-config memory
-    evidence next to each throughput row."""
-    import jax
+    evidence next to each throughput row. Reads through the shared
+    telemetry gauge helper; output keys stay the legacy bench-schema
+    names that ADVICE/VERDICT parsers grep for."""
+    from progen_tpu.telemetry import hbm_gauges
 
-    stats = getattr(jax.devices()[0], "memory_stats", lambda: None)() or {}
+    g = hbm_gauges()
     out = {}
-    if "peak_bytes_in_use" in stats:
-        out["peak_hbm_gb"] = round(stats["peak_bytes_in_use"] / 2**30, 2)
-    if "bytes_limit" in stats:
-        out["hbm_limit_gb"] = round(stats["bytes_limit"] / 2**30, 2)
+    if "hbm/peak_gb" in g:
+        out["peak_hbm_gb"] = round(g["hbm/peak_gb"], 2)
+    if "hbm/limit_gb" in g:
+        out["hbm_limit_gb"] = round(g["hbm/limit_gb"], 2)
     return out
 
 
@@ -1156,9 +1165,15 @@ def _decode_serve_bench() -> dict:
             m.get("prefill_tokens_per_s", 0.0), 1
         ),
         "ttft_mean_s": round(m.get("ttft_s_mean_s", 0.0), 4),
+        "ttft_p50_s": round(m.get("ttft_s_p50_s", 0.0), 4),
+        "ttft_p95_s": round(m.get("ttft_s_p95_s", 0.0), 4),
+        "ttft_p99_s": round(m.get("ttft_s_p99_s", 0.0), 4),
         "ttft_max_s": round(m.get("ttft_s_max_s", 0.0), 4),
         "request_latency_mean_s": round(
             m.get("latency_s_mean_s", 0.0), 4
+        ),
+        "request_latency_p99_s": round(
+            m.get("latency_s_p99_s", 0.0), 4
         ),
         "decode_steps": int(m.get("decode_steps", 0)),
         "mean_occupancy": round(
@@ -1547,6 +1562,12 @@ def main() -> None:
     budget = float(os.environ.get("BENCH_BUDGET_SEC", "3000"))
     started = time.perf_counter()
     resume = "--resume" in sys.argv
+    # span trail for the whole suite: a B with no E in
+    # runs/bench_logs/events.jsonl names the phase the run died in
+    from progen_tpu import telemetry
+
+    _LOG_DIR.mkdir(parents=True, exist_ok=True)
+    telemetry.configure(path=_LOG_DIR / "events.jsonl")
     # one probe serves liveness + platform (phase children skip re-probing
     # via BENCH_REQUIRE_TPU — a dead relay there surfaces as a timeout)
     on_tpu = _is_tpu_platform(_probe_platform())
@@ -1609,7 +1630,8 @@ def main() -> None:
                 {"phase": name, "error": "skipped: budget exhausted"}
             )
             continue
-        res = _run_phase_subprocess(name, min(timeout, remaining))
+        with telemetry.span(f"bench/{name}", timeout=timeout):
+            res = _run_phase_subprocess(name, min(timeout, remaining))
         if "error" not in res and not _is_tpu_platform(
             res.get("platform", "tpu")
         ):
@@ -1724,6 +1746,17 @@ if __name__ == "__main__":
 
             signal.signal(signal.SIGALRM, _deadline)
             signal.alarm(deadline)
+            # stall watchdog below the SIGALRM horizon: when the phase
+            # wedges (device hang, dead relay), all-thread stacks + the
+            # open spans land in this child's stderr — the phase log the
+            # parent tails into log_tail on the timeout kill — BEFORE
+            # the alarm/kill destroys the evidence. _mark() beats it, so
+            # it only fires when the progress trail actually stops.
+            from progen_tpu.telemetry import StallWatchdog
+
+            _WATCHDOG = StallWatchdog(
+                max(60.0, deadline * 0.6), file=sys.stderr
+            ).start()
         try:
             if os.environ.get("BENCH_REQUIRE_TPU") == "1":
                 # orchestrated child: the parent already probed; a dead

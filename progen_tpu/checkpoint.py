@@ -36,6 +36,8 @@ import jax
 import orbax.checkpoint as ocp
 from jax.sharding import NamedSharding
 
+from progen_tpu import telemetry
+
 CKPT_PREFIX = "ckpt_"
 DEFAULT_KEEP_LAST_N = 500  # reference default, train.py:48
 
@@ -150,13 +152,16 @@ def get_checkpoint_fns(
         meta.json + run retention (coordinator only)."""
         import jax
 
-        if "ckptr" in _async:
-            _async["ckptr"].wait_until_finished()
-        item = _async.pop("pending", None)
-        if item is not None and jax.process_index() == 0:
-            target, meta = item
-            _write_text(target / "meta.json", json.dumps(meta))
-            _retain()
+        if not _async:
+            return  # sync mode / nothing in flight: span-free no-op
+        with telemetry.span("ckpt/finalize"):
+            if "ckptr" in _async:
+                _async["ckptr"].wait_until_finished()
+            item = _async.pop("pending", None)
+            if item is not None and jax.process_index() == 0:
+                target, meta = item
+                _write_text(target / "meta.json", json.dumps(meta))
+                _retain()
 
     def _close() -> None:
         """Publish any pending save, then shut the background commit
@@ -169,7 +174,7 @@ def get_checkpoint_fns(
         if ckptr is not None:
             ckptr.close()
 
-    def save(package: Package) -> str:
+    def _save(package: Package) -> str:
         # unix-time naming (checkpoint.py:27-30) made collision-proof: two
         # saves within the same second get strictly increasing names, so
         # lexicographic order == save order always holds. Multi-host: every
@@ -224,13 +229,19 @@ def get_checkpoint_fns(
             _retain()
         return str(target)
 
+    def save(package: Package) -> str:
+        # the span (B with no E in events.jsonl = died mid-save) rides
+        # the process telemetry; goodput crediting stays with the caller
+        with telemetry.span("ckpt/save", async_mode=async_save):
+            return _save(package)
+
     save.flush = _finalize_pending  # await + publish the in-flight save
     save.close = _close  # flush + stop the background commit thread
 
     def _complete(candidates):
         return [p for p in candidates if _exists(p / "meta.json")]
 
-    def get_last(abstract_state: Any = None) -> Optional[Package]:
+    def _get_last(abstract_state: Any = None) -> Optional[Package]:
         candidates = _complete(_list())
         if not candidates:
             return None
@@ -246,7 +257,11 @@ def get_checkpoint_fns(
             train_config=meta.get("train_config"),
         )
 
-    def restore_params(abstract_params: Any = None) -> Optional[Package]:
+    def get_last(abstract_state: Any = None) -> Optional[Package]:
+        with telemetry.span("ckpt/restore"):
+            return _get_last(abstract_state)
+
+    def _restore_params(abstract_params: Any = None) -> Optional[Package]:
         """Params-only restore for inference (sample CLI): skips the Adam
         moments — ~2/3 of the checkpoint bytes, which matters at 1.2B on a
         small sampling box. ``state`` in the returned Package is just the
@@ -313,6 +328,10 @@ def get_checkpoint_fns(
             run_id=meta["run_id"],
             train_config=meta.get("train_config"),
         )
+
+    def restore_params(abstract_params: Any = None) -> Optional[Package]:
+        with telemetry.span("ckpt/restore_params"):
+            return _restore_params(abstract_params)
 
     get_last.restore_params = restore_params
 

@@ -149,10 +149,24 @@ def _build(checkpoint_path, max_slots, max_len, max_queue):
               help="serve a unix domain socket at PATH instead of "
                    "stdin/stdout")
 @click.option("--metrics-every", default=0,
-              help="log a serve/ metrics snapshot to the tracker every "
-                   "N decode steps (0 = only at exit)")
+              help="log a serve/ metrics snapshot to the tracker (and "
+                   "rewrite --prom_file) every N decode steps "
+                   "(0 = only at exit)")
+@click.option("--prom_file", default=None, type=str,
+              help="write Prometheus text exposition here (atomic "
+                   "rewrite on the --metrics-every cadence and at exit; "
+                   "node-exporter textfile-collector compatible)")
+@click.option("--prom_port", default=0,
+              help="serve Prometheus text exposition over HTTP on this "
+                   "localhost port (0 = off)")
 def main(checkpoint_path, max_slots, max_queue, max_len, top_k,
-         temperature, top_p, seed, socket_path, metrics_every):
+         temperature, top_p, seed, socket_path, metrics_every,
+         prom_file, prom_port):
+    from progen_tpu.telemetry import (
+        prometheus_text,
+        start_prometheus_server,
+        write_prometheus,
+    )
     from progen_tpu.tracking import make_tracker
 
     sched, engine = _build(checkpoint_path, max_slots, max_len, max_queue)
@@ -161,6 +175,22 @@ def main(checkpoint_path, max_slots, max_queue, max_len, top_k,
         "temperature": temperature, "top_p": top_p, "seed": seed,
     }
     tracker = make_tracker("progen-serve")
+
+    def publish(step=None):
+        sched.metrics.log_to(tracker, step=step)
+        if prom_file:
+            write_prometheus(prom_file, prometheus_text(sched.metrics))
+
+    prom_srv = None
+    if prom_port:
+        prom_srv = start_prometheus_server(
+            lambda: prometheus_text(sched.metrics), port=prom_port
+        )
+        print(
+            f"prometheus on http://127.0.0.1:"
+            f"{prom_srv.server_address[1]}/metrics",
+            file=sys.stderr,
+        )
     print(
         f"serving: max_slots={engine.max_slots} max_len={engine.max_len} "
         f"max_queue={sched.max_queue}",
@@ -168,12 +198,14 @@ def main(checkpoint_path, max_slots, max_queue, max_len, top_k,
     )
     try:
         if socket_path:
-            _serve_socket(sched, defaults, socket_path, tracker,
+            _serve_socket(sched, defaults, socket_path, publish,
                           metrics_every)
         else:
-            _serve_stdio(sched, defaults, tracker, metrics_every)
+            _serve_stdio(sched, defaults, publish, metrics_every)
     finally:
-        sched.metrics.log_to(tracker)
+        publish()
+        if prom_srv is not None:
+            prom_srv.shutdown()
         tracker.finish()
 
 
@@ -194,7 +226,7 @@ def _submit_line(sched, line, defaults):
     return None, req
 
 
-def _serve_stdio(sched, defaults, tracker, metrics_every):
+def _serve_stdio(sched, defaults, publish, metrics_every):
     """stdin-JSONL transport: poll stdin between decode steps so new
     requests join mid-flight (continuous batching, not read-all-then-
     drain); EOF stops intake and the loop drains what remains."""
@@ -231,10 +263,10 @@ def _serve_stdio(sched, defaults, tracker, metrics_every):
             emit(_events_to_lines(events, comps, starts))
             steps += 1
             if metrics_every and steps % metrics_every == 0:
-                sched.metrics.log_to(tracker, step=steps)
+                publish(steps)
 
 
-def _serve_socket(sched, defaults, socket_path, tracker, metrics_every):
+def _serve_socket(sched, defaults, socket_path, publish, metrics_every):
     """Unix-socket transport: one select loop over {listener, clients,
     engine}; request ids are namespaced per connection internally so two
     clients may both call their request "1"."""
@@ -327,7 +359,7 @@ def _serve_socket(sched, defaults, socket_path, tracker, metrics_every):
                     send(fd, _events_to_lines([], [c], {public: start}))
                 steps += 1
                 if metrics_every and steps % metrics_every == 0:
-                    sched.metrics.log_to(tracker, step=steps)
+                    publish(steps)
     finally:
         for fd in list(clients):
             _drop(fd)

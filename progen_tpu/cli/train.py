@@ -110,6 +110,11 @@ def confirm(question: str) -> bool:
                    "O(microbatches) boundary activations) or 1f1b "
                    "(interleaved fwd/bwd, O(stages) in-flight activations "
                    "— the large-microbatch-count deployment)")
+@click.option("--stall_timeout", default=900.0,
+              help="stall-watchdog deadline (seconds): when no optimizer "
+                   "step completes within it, dump all-thread stacks and "
+                   "the open/recent telemetry spans to stderr, then keep "
+                   "running (0 = off)")
 def main(
     seed,
     batch_size,
@@ -149,6 +154,7 @@ def main(
     mesh_pipe,
     pipe_microbatches,
     pipe_schedule,
+    stall_timeout,
 ):
     from progen_tpu.checkpoint import Package, get_checkpoint_fns
     from progen_tpu.config import ProGenConfig, load_toml_config
@@ -337,6 +343,20 @@ def main(
     num_params = state.num_params()
     tracker.set_config({**config.to_dict(), "num_params": num_params})
 
+    # --- telemetry: spans ride the tracker's event stream (events.jsonl
+    # next to metrics.jsonl; Noop on non-coordinators / --wandb_off), the
+    # ledger classifies the loop's wall clock from here on
+    from progen_tpu import telemetry
+    from progen_tpu.telemetry import (
+        GoodputLedger,
+        StallWatchdog,
+        hbm_gauges,
+        step_print,
+    )
+
+    telemetry.configure(sink=tracker.log_event)
+    ledger = GoodputLedger()
+
     # --- data
     num_train, train_iter_fn = iterator_from_tfrecords_folder(data_path)
     num_valid, valid_iter_fn = iterator_from_tfrecords_folder(
@@ -373,8 +393,11 @@ def main(
         return np.pad(m, ((0, local_bs - m.shape[0]), (0, 0)))
 
     def next_super_batch():
-        micro = [pad_rows(next(train_ds)) for _ in range(grad_accum_every)]
-        return put_batch(np.stack(micro), mesh, accum_axis=True)
+        with ledger.track("data"):
+            micro = [
+                pad_rows(next(train_ds)) for _ in range(grad_accum_every)
+            ]
+            return put_batch(np.stack(micro), mesh, accum_axis=True)
 
     import tqdm
 
@@ -414,37 +437,55 @@ def main(
     # metric step continues across resumes (state.step is checkpointed);
     # a restarted loop must not rewind the tracker's step axis
     start_step = int(jax.device_get(state.step))
+    # stall watchdog: beaten once per loop iteration below; a wedged
+    # collective / device hang then leaves stacks + open spans in stderr
+    # instead of a silent timeout kill (BASELINE.md's "dead all window")
+    watchdog = (
+        StallWatchdog(stall_timeout).start() if stall_timeout > 0 else None
+    )
     try:
       with mesh:
         # compiled steps live INSIDE the try: a jit failure here must
         # still run the finally that stops the loop=True prefetch workers
-        if mesh_pipe > 1:
-            if pipe_schedule == "1f1b":
-                from progen_tpu.parallel.pipeline_1f1b import (
-                    compile_1f1b_train_step,
-                )
+        with telemetry.span("train/compile"), ledger.track("compile") as tr:
+            if mesh_pipe > 1:
+                if pipe_schedule == "1f1b":
+                    from progen_tpu.parallel.pipeline_1f1b import (
+                        compile_1f1b_train_step,
+                    )
 
-                train_step = compile_1f1b_train_step(
-                    model, optimizer, shardings, mesh,
-                    n_microbatches=pipe_m,
+                    train_step = compile_1f1b_train_step(
+                        model, optimizer, shardings, mesh,
+                        n_microbatches=pipe_m,
+                    )
+                else:
+                    from progen_tpu.parallel.pipeline import (
+                        compile_pipeline_train_step,
+                    )
+
+                    train_step = compile_pipeline_train_step(
+                        model, optimizer, shardings, mesh,
+                        n_microbatches=pipe_m,
+                    )
+                # rules=(): GSPMD activation constraints are meaningless
+                # when the model axis holds stages, and the step runs
+                # without them
+                eval_step = compile_eval_step(
+                    model, shardings, mesh, rules=()
                 )
             else:
-                from progen_tpu.parallel.pipeline import (
-                    compile_pipeline_train_step,
+                train_step = compile_train_step(
+                    model, optimizer, state, shardings, mesh
                 )
-
-                train_step = compile_pipeline_train_step(
-                    model, optimizer, shardings, mesh,
-                    n_microbatches=pipe_m,
-                )
-            # rules=(): GSPMD activation constraints are meaningless when
-            # the model axis holds stages, and the step runs without them
-            eval_step = compile_eval_step(model, shardings, mesh, rules=())
-        else:
-            train_step = compile_train_step(
-                model, optimizer, state, shardings, mesh
-            )
-            eval_step = compile_eval_step(model, shardings, mesh)
+                eval_step = compile_eval_step(model, shardings, mesh)
+        # post-compile HBM is the first OOM-relevant reading: weights +
+        # optimizer state + compiled-program reservations are all resident
+        tracker.log(
+            {"compile_s": round(tr.seconds, 3), **hbm_gauges()},
+            step=start_step,
+        )
+        if watchdog is not None:
+            watchdog.beat()  # compile done; the step clock starts now
         # pre-fetch only when the loop will actually run: resuming a
         # completed run (empty seq_indices) must fall through, not block
         # on a skip-exhausted iterator
@@ -462,9 +503,12 @@ def main(
             nonlocal pending
             if pending is None:
                 return
-            p_step, p_metrics = pending
+            p_step, p_metrics, p_bucket = pending
             pending = None
-            loss = float(p_metrics["last_micro_loss"])  # host sync fence
+            with ledger.track(p_bucket):
+                # host sync fence: the wait here IS the device step time
+                # (or, for the first step under lazy jit, the compile)
+                loss = float(p_metrics["last_micro_loss"])
             if not math.isfinite(loss):
                 # failure detection (SURVEY §5): stop before a NaN spreads
                 # into the checkpoint rotation
@@ -473,9 +517,13 @@ def main(
                     f"last checkpoint is intact — restart resumes from it"
                 )
             perf = timer.tick(effective_batch * config.seq_len)
-            if is_coordinator():
-                print(f"loss: {loss:.4f}")
-            tracker.log({"loss": loss, **(perf or {})}, step=p_step)
+            with ledger.track("log"):
+                if is_coordinator():
+                    step_print(p_step, f"loss: {loss:.4f}")
+                tracker.log(
+                    {"loss": loss, **(perf or {}), **hbm_gauges()},
+                    step=p_step,
+                )
         for i, seq_index in enumerate(tqdm.tqdm(seq_indices, mininterval=10)):
             stop = stop_requested["flag"]
             if jax.process_count() > 1:
@@ -496,7 +544,13 @@ def main(
 
                 jax_profiler.start_trace(profile_dir)
                 profiler_active = True
-            state, metrics = train_step(state, batch)
+            # the first call of a lazily-jitted step traces and compiles
+            # synchronously — that's compile time, not step time
+            step_bucket = "compile" if steps_done == 0 else "step"
+            with ledger.track(step_bucket):
+                # async dispatch: cheap when the device is pipelined, the
+                # full wait shows up at flush_metrics' host sync instead
+                state, metrics = train_step(state, batch)
             steps_done += 1
             # prepare the NEXT batch while the device is busy (async
             # dispatch): host input pipeline overlaps device compute —
@@ -510,7 +564,9 @@ def main(
             # log the PREVIOUS step (already complete — no device stall),
             # then queue this one
             flush_metrics()
-            pending = (global_step, metrics)
+            pending = (global_step, metrics, step_bucket)
+            if watchdog is not None:
+                watchdog.beat()
             # single source of truth for the cadence triggers: sync_now
             # MUST cover every condition that writes a checkpoint below,
             # or a NaN state could enter the rotation unchecked
@@ -526,47 +582,65 @@ def main(
                 profiler_active = False
 
             next_seq_index = seq_index + effective_batch
+            # cadence work below runs between step timings; each block
+            # credits its goodput bucket AND excludes itself from the
+            # StepTimer window, so step_ms/MFU stay pure step numbers
+            # instead of silently absorbing checkpoint/eval/sample time
             if do_ckpt:
-                save_ckpt(
-                    Package(
-                        next_seq_index=next_seq_index,
-                        state=state,
-                        model_config=config.to_dict(),
-                        run_id=run_id,
-                        train_config=train_config,
+                with telemetry.span("train/ckpt", step=global_step), \
+                        ledger.track("checkpoint") as tr:
+                    save_ckpt(
+                        Package(
+                            next_seq_index=next_seq_index,
+                            state=state,
+                            model_config=config.to_dict(),
+                            run_id=run_id,
+                            train_config=train_config,
+                        )
                     )
-                )
+                timer.exclude(tr.seconds)
             if do_valid:
-                vloss = float(
-                    eval_step(
-                        state, put_batch(pad_rows(next(valid_ds)), mesh)
+                with telemetry.span("train/eval", step=global_step), \
+                        ledger.track("eval") as tr:
+                    vloss = float(
+                        eval_step(
+                            state, put_batch(pad_rows(next(valid_ds)), mesh)
+                        )
                     )
-                )
+                timer.exclude(tr.seconds)
                 if is_coordinator():
-                    print(f"valid_loss: {vloss:.4f}")
-                tracker.log({"valid_loss": vloss}, step=global_step)
+                    step_print(global_step, f"valid_loss: {vloss:.4f}")
+                tracker.log(
+                    {"valid_loss": vloss, **ledger.report()},
+                    step=global_step,
+                )
             if do_sample:
-                valid_batch = np.asarray(next(valid_ds))
-                prime = valid_batch[0, 1 : prime_length + 1]  # skip BOS col
-                if jax.process_count() > 1:
-                    # every process must feed the IDENTICAL prime into the
-                    # jitted decode over globally-sharded params
-                    from jax.experimental import multihost_utils
+                with telemetry.span("train/sample", step=global_step), \
+                        ledger.track("sample") as tr:
+                    valid_batch = np.asarray(next(valid_ds))
+                    prime = valid_batch[0, 1 : prime_length + 1]  # skip BOS
+                    if jax.process_count() > 1:
+                        # every process must feed the IDENTICAL prime into
+                        # the jitted decode over globally-sharded params
+                        from jax.experimental import multihost_utils
 
-                    prime = multihost_utils.broadcast_one_to_all(prime)
-                sampled = sample_tokens(
-                    jax.random.fold_in(sample_rng, i),
-                    model,
-                    state.params,
-                    prime,
-                    config.seq_len,
-                    top_k=25,
-                    add_bos=True,
-                )
-                prime_str = decode_tokens(prime)
-                sampled_str = decode_tokens(np.asarray(sampled)[prime_length + 1 :])
+                        prime = multihost_utils.broadcast_one_to_all(prime)
+                    sampled = sample_tokens(
+                        jax.random.fold_in(sample_rng, i),
+                        model,
+                        state.params,
+                        prime,
+                        config.seq_len,
+                        top_k=25,
+                        add_bos=True,
+                    )
+                    prime_str = decode_tokens(prime)
+                    sampled_str = decode_tokens(
+                        np.asarray(sampled)[prime_length + 1 :]
+                    )
+                timer.exclude(tr.seconds)
                 if is_coordinator():
-                    print(f"sample: {sampled_str[:120]}")
+                    step_print(global_step, f"sample: {sampled_str[:120]}")
                 tracker.log_html(
                     "samples",
                     render_sample_html(prime_str, sampled_str),
@@ -575,10 +649,26 @@ def main(
         # stop-flag / exhausted-iterator exits leave the last step queued:
         # its loss (and the non-finite gate) must land before the final save
         flush_metrics()
+        # goodput closes the books on the loop: MFU said how fast the
+        # steps were, this says how often the loop was actually stepping
+        report = ledger.report()
+        tracker.log(report, step=start_step + steps_done)
+        if is_coordinator():
+            step_print(
+                start_step + steps_done,
+                f"goodput: {report['goodput_pct']:.1f}% of "
+                f"{report['wall_s']:.1f}s wall "
+                f"(attributed {report['coverage_pct']:.1f}%)",
+            )
 
     finally:
         # nested so each cleanup runs even if an earlier one raises
         try:
+            if watchdog is not None:
+                watchdog.stop()
+            # detach the span sink BEFORE the tracker closes its files:
+            # a later span in this process must not write to a dead fd
+            telemetry.configure()
             if profiler_active:
                 from jax import profiler as jax_profiler
 

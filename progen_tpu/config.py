@@ -142,7 +142,56 @@ class ProGenConfig:
 
 
 def load_toml_config(path: str) -> dict:
-    import tomllib
+    try:
+        import tomllib
+    except ModuleNotFoundError:  # Python < 3.11
+        return _parse_toml_minimal(path)
 
     with open(path, "rb") as f:
         return tomllib.load(f)
+
+
+def _parse_toml_minimal(path: str) -> dict:
+    """Fallback TOML-subset parser for hosts without ``tomllib``.
+
+    Supports exactly what the repo's config files use: comments, bare
+    ``[section]`` tables, and ``key = value`` with string / bool / int /
+    float values. Anything richer raises rather than misparsing.
+    """
+    root: dict = {}
+    table = root
+    with open(path, encoding="utf-8") as f:
+        for lineno, raw in enumerate(f, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            if line.startswith("[") and line.endswith("]"):
+                table = root.setdefault(line[1:-1].strip(), {})
+                continue
+            key, sep, value = line.partition("=")
+            if not sep:
+                raise ValueError(f"{path}:{lineno}: expected key = value")
+            table[key.strip()] = _toml_value(value.strip(), f"{path}:{lineno}")
+    return root
+
+
+def _toml_value(s: str, where: str):
+    if s[:1] in ("\"", "'"):
+        q = s[0]
+        end = s.find(q, 1)
+        if end < 0 or s[end + 1:].split("#", 1)[0].strip():
+            raise ValueError(f"{where}: unsupported TOML string {s!r}")
+        return s[1:end]
+    s = s.split("#", 1)[0].strip()
+    if s == "true":
+        return True
+    if s == "false":
+        return False
+    try:
+        return int(s)
+    except ValueError:
+        pass
+    try:
+        return float(s)
+    except ValueError:
+        raise ValueError(f"{where}: unsupported TOML value {s!r}") from None

@@ -117,59 +117,66 @@ class LocalAttentionBlock(nn.Module):
         if c.rotate_value:  # reference rotates v too (progen.py:87)
             v = apply_rotary_pos_emb(v, sin, cos)
 
-        if c.decode:
-            out = self._decode_attend(q, k, v, pos)  # (b, h, 1, dh)
-        elif (
-            c.use_ring_attn
-            and self.mesh is not None
-            and dict(getattr(self.mesh, "shape", {})).get("seq", 1) > 1
-            and not self.is_initializing()
-        ):
-            # explicit one-hop halo exchange over the ``seq`` ring instead
-            # of GSPMD-inferred collectives. Skipped during init: the dummy
-            # init batch (1, L) doesn't divide over the data axis, and the
-            # op is parameter-free so init doesn't need it for shapes.
-            from progen_tpu.parallel.ring_attention import (
-                ring_local_attention,
-            )
-
-            # use_pallas_attn composes: each ring shard runs the measured
-            # kernel (halo-aware variant) instead of the XLA dense path
-            out = ring_local_attention(
-                q, k, v, window_size=w, mesh=self.mesh,
-                use_pallas=c.use_pallas_attn,
-            )
-        elif c.use_pallas_attn:
-            from progen_tpu.ops.pallas_attention import (
-                measured_impls,
-                pallas_local_attention,
-            )
-
-            # positional args: custom_vjp nondiff_argnums are positional.
-            # Mosaic-compiled on TPU; interpreter elsewhere, so a config
-            # shipping use_pallas_attn=true (long8k.toml) stays runnable
-            # on CPU hosts (tests, smoke runs) without monkeypatching.
-            # use_pallas_attn means "best measured kernel combo for this
-            # shape" — per-direction winners from the policy table keyed
-            # on (window, n, batch*heads); pallas_bh_block >= 1 (0 = unset)
-            # overrides the policy's forward blocking, so an explicit 1
-            # can force one-window-per-program even where the policy
-            # picked a batched forward.
-            interpret = jax.default_backend() not in ("tpu", "axon")
-            fwd_impl, bwd_impl, g = measured_impls(w, n=n, bh=b * h)
-            if c.pallas_bh_block:
-                g = c.pallas_bh_block  # explicit config beats the policy
-            if fwd_impl == "xla" and bwd_impl == "xla":
-                # both directions lost on-chip at this shape: plain XLA
-                # autodiff (going through the custom VJP would recompute
-                # the forward inside the backward for nothing)
-                out = local_attention(q, k, v, window_size=w)
-            else:
-                out = pallas_local_attention(
-                    q, k, v, w, None, interpret, bwd_impl, g, fwd_impl
+        # one scope over every dispatch path: XProf rows read
+        # "attention_core" whether the step ran XLA, ring, or Pallas
+        with jax.named_scope("attention_core"):
+            if c.decode:
+                out = self._decode_attend(q, k, v, pos)  # (b, h, 1, dh)
+            elif (
+                c.use_ring_attn
+                and self.mesh is not None
+                and dict(getattr(self.mesh, "shape", {})).get("seq", 1) > 1
+                and not self.is_initializing()
+            ):
+                # explicit one-hop halo exchange over the ``seq`` ring
+                # instead of GSPMD-inferred collectives. Skipped during
+                # init: the dummy init batch (1, L) doesn't divide over
+                # the data axis, and the op is parameter-free so init
+                # doesn't need it for shapes.
+                from progen_tpu.parallel.ring_attention import (
+                    ring_local_attention,
                 )
-        else:
-            out = local_attention(q, k, v, window_size=w)
+
+                # use_pallas_attn composes: each ring shard runs the
+                # measured kernel (halo-aware variant) instead of the XLA
+                # dense path
+                out = ring_local_attention(
+                    q, k, v, window_size=w, mesh=self.mesh,
+                    use_pallas=c.use_pallas_attn,
+                )
+            elif c.use_pallas_attn:
+                from progen_tpu.ops.pallas_attention import (
+                    measured_impls,
+                    pallas_local_attention,
+                )
+
+                # positional args: custom_vjp nondiff_argnums are
+                # positional. Mosaic-compiled on TPU; interpreter
+                # elsewhere, so a config shipping use_pallas_attn=true
+                # (long8k.toml) stays runnable on CPU hosts (tests, smoke
+                # runs) without monkeypatching. use_pallas_attn means
+                # "best measured kernel combo for this shape" —
+                # per-direction winners from the policy table keyed on
+                # (window, n, batch*heads); pallas_bh_block >= 1 (0 =
+                # unset) overrides the policy's forward blocking, so an
+                # explicit 1 can force one-window-per-program even where
+                # the policy picked a batched forward.
+                interpret = jax.default_backend() not in ("tpu", "axon")
+                fwd_impl, bwd_impl, g = measured_impls(w, n=n, bh=b * h)
+                if c.pallas_bh_block:
+                    g = c.pallas_bh_block  # explicit config beats policy
+                if fwd_impl == "xla" and bwd_impl == "xla":
+                    # both directions lost on-chip at this shape: plain
+                    # XLA autodiff (going through the custom VJP would
+                    # recompute the forward inside the backward for
+                    # nothing)
+                    out = local_attention(q, k, v, window_size=w)
+                else:
+                    out = pallas_local_attention(
+                        q, k, v, w, None, interpret, bwd_impl, g, fwd_impl
+                    )
+            else:
+                out = local_attention(q, k, v, window_size=w)
 
         out = out.transpose(0, 2, 1, 3).reshape(b, n, c.inner_dim)
         out = nn.with_logical_constraint(out, ("batch", "seq_act", None))
@@ -286,33 +293,35 @@ class SpatialGatingUnit(nn.Module):
             c.params_dtype,
         )
 
-        if c.decode:
-            # incremental spatial mix: keep the LayerNormed gate history and
-            # contract the current causal row of the (n, n) matrix with it —
-            # out[pos] = sum_{j<=pos} W[pos, j] * gate[j] + b[pos]
-            b_sz, half = gate.shape[0], gate.shape[-1]
-            hist = self.variable(
-                "cache", "gate_history",
-                lambda: jnp.zeros((b_sz, n, half), jnp.float32),
-            )
-            if not self.is_initializing():
-                hist.value = jax.lax.dynamic_update_slice_in_dim(
-                    hist.value, gate.astype(jnp.float32), pos, axis=1
+        with jax.named_scope("sgu_spatial_mix"):
+            if c.decode:
+                # incremental spatial mix: keep the LayerNormed gate
+                # history and contract the current causal row of the
+                # (n, n) matrix with it —
+                # out[pos] = sum_{j<=pos} W[pos, j] * gate[j] + b[pos]
+                b_sz, half = gate.shape[0], gate.shape[-1]
+                hist = self.variable(
+                    "cache", "gate_history",
+                    lambda: jnp.zeros((b_sz, n, half), jnp.float32),
                 )
-            row = jax.lax.dynamic_index_in_dim(
-                weights.astype(jnp.float32), pos, axis=0, keepdims=False
-            )
-            row = jnp.where(jnp.arange(n) <= pos, row, 0.0)
-            mixed = jnp.einsum("bnd,n->bd", hist.value, row)
-            mixed = mixed + jax.lax.dynamic_index_in_dim(
-                biases.astype(jnp.float32), pos, axis=0, keepdims=False
-            )
-            gate = mixed[:, None, :].astype(x.dtype)
-        else:
-            gate = causal_sgu_mix(
-                gate, weights, biases, c.sgu_block_size
-            ).astype(x.dtype)
-        x = x * gate
+                if not self.is_initializing():
+                    hist.value = jax.lax.dynamic_update_slice_in_dim(
+                        hist.value, gate.astype(jnp.float32), pos, axis=1
+                    )
+                row = jax.lax.dynamic_index_in_dim(
+                    weights.astype(jnp.float32), pos, axis=0, keepdims=False
+                )
+                row = jnp.where(jnp.arange(n) <= pos, row, 0.0)
+                mixed = jnp.einsum("bnd,n->bd", hist.value, row)
+                mixed = mixed + jax.lax.dynamic_index_in_dim(
+                    biases.astype(jnp.float32), pos, axis=0, keepdims=False
+                )
+                gate = mixed[:, None, :].astype(x.dtype)
+            else:
+                gate = causal_sgu_mix(
+                    gate, weights, biases, c.sgu_block_size
+                ).astype(x.dtype)
+            x = x * gate
         return nn.Dense(
             self.dim_out,
             dtype=c.compute_dtype,
@@ -351,11 +360,12 @@ class FeedForwardBlock(nn.Module):
             name="proj_in",
         )(x)
 
-        if self.glu:
-            x, gate = jnp.split(x, 2, axis=-1)
-            x = x * jax.nn.gelu(gate)
-        else:
-            x = jax.nn.gelu(x)
+        with jax.named_scope("ffn_activation"):
+            if self.glu:
+                x, gate = jnp.split(x, 2, axis=-1)
+                x = x * jax.nn.gelu(gate)
+            else:
+                x = jax.nn.gelu(x)
 
         if self.spatial_gate:
             x = SpatialGatingUnit(c, dim_out=hidden // 2, name="sgu")(x, pos)

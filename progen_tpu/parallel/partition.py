@@ -97,6 +97,33 @@ PIPELINE_RULES = (
 _TPU_DEV_PATHS = ("/dev/accel0", "/dev/vfio/0")
 
 
+def shard_map(fn, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` across jax versions: the top-level export with
+    ``check_vma`` (jax >= 0.6) or ``jax.experimental.shard_map`` where the
+    same knob is spelled ``check_rep`` (older releases)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
+
+def pcast(x, axes, *, to: str):
+    """``jax.lax.pcast`` where it exists (the varying-manual-axes typing
+    of jax >= 0.7); identity on older releases, whose shard_map has no
+    vma types — replication is tracked by check_rep instead, so the cast
+    has nothing to record."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axes, to=to)
+    return x
+
+
 def _tpu_pod_worker_count() -> int:
     """Worker count from the TPU runtime env (GKE sets
     ``TPU_WORKER_HOSTNAMES`` as a comma list on every pod worker; single

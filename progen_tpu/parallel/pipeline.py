@@ -34,6 +34,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from progen_tpu.parallel.partition import pcast, shard_map
+
 
 def pipeline_apply(
     block_fn: Callable,
@@ -108,16 +110,14 @@ def pipeline_apply(
         # 0.9 varying-manual-axes typing for scan-of-ppermute); under DP
         # composition the zeros_like already inherits the data-varying type
         # from the sharded input, so only the stage axis needs the cast
-        init = jax.lax.pcast(
-            jnp.zeros_like(x_mb[0]), (axis,), to="varying"
-        )
+        init = pcast(jnp.zeros_like(x_mb[0]), (axis,), to="varying")
         _, outs = jax.lax.scan(tick, init, jnp.arange(T))
         # the LAST stage's outputs at ticks P-1 .. P-1+M-1 are the finished
         # microbatches; other stages' rows are bubble garbage that the
         # (P, ...)-stacked out_spec lets the caller discard
         return outs[None]  # (1, T, mb, ...) -> stage-stacked by out_spec
 
-    outs = jax.shard_map(
+    outs = shard_map(
         stage_fn,
         mesh=mesh,
         in_specs=(P(axis), P(None, data_axis) if dp else P()),

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from progen_tpu.ops.attention import local_attention
+from progen_tpu.parallel.partition import shard_map
 
 
 def ring_local_attention(
@@ -124,7 +125,7 @@ def ring_local_attention(
     override = os.environ.get("PROGEN_RING_CHECK_VMA")
     if override in ("0", "1"):
         check_vma = override == "1"
-    return jax.shard_map(
+    return shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(spec, spec, spec),

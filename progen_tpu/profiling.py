@@ -82,6 +82,13 @@ class StepTimer:
         self._steps = 0
         self._time = 0.0
         self._tokens = 0
+        self._excluded = 0.0
+
+    def exclude(self, seconds: float) -> None:
+        """Subtract known non-step work (checkpoint/eval/sample between
+        ticks) from the next ``tick``'s window, so cadence work no longer
+        inflates step_ms / deflates MFU."""
+        self._excluded += max(seconds, 0.0)
 
     def tick(self, tokens: int) -> Optional[dict]:
         """Returns {step_ms, tokens_per_sec_per_chip, mfu} once measuring
@@ -89,8 +96,10 @@ class StepTimer:
         now = time.perf_counter()
         if self._last is None:
             self._last = now
+            self._excluded = 0.0
             return None
-        dt, self._last = now - self._last, now
+        dt = max(now - self._last - self._excluded, 0.0)
+        self._last, self._excluded = now, 0.0
         self._steps += 1
         if self._steps <= self.warmup:
             return None

@@ -16,34 +16,59 @@ actually spent), not a gauge that depends on when you look.
 from __future__ import annotations
 
 import math
+import random
 from typing import Dict, Optional
+
+_RESERVOIR_CAP = 512
+_QUANTILES = ((0.5, "p50_s"), (0.95, "p95_s"), (0.99, "p99_s"))
 
 
 class _Timing:
-    """Running sum/count/min/max for an observed duration."""
+    """Running sum/count/min/max plus a fixed-size uniform reservoir
+    (Vitter's Algorithm R) for tail quantiles — latency SLOs live at
+    p99, where a mean is actively misleading. Seeded RNG keeps runs
+    reproducible; memory is bounded at ``_RESERVOIR_CAP`` floats per
+    timing family regardless of request count."""
 
-    __slots__ = ("sum", "count", "min", "max")
+    __slots__ = ("sum", "count", "min", "max", "_reservoir", "_rng")
 
     def __init__(self):
         self.sum = 0.0
         self.count = 0
         self.min = math.inf
         self.max = 0.0
+        self._reservoir: list = []
+        self._rng = random.Random(0)
 
     def observe(self, v: float) -> None:
         self.sum += v
         self.count += 1
         self.min = min(self.min, v)
         self.max = max(self.max, v)
+        if len(self._reservoir) < _RESERVOIR_CAP:
+            self._reservoir.append(v)
+        else:
+            j = self._rng.randrange(self.count)
+            if j < _RESERVOIR_CAP:
+                self._reservoir[j] = v
+
+    def quantile(self, q: float) -> float:
+        if not self._reservoir:
+            return 0.0
+        xs = sorted(self._reservoir)
+        return xs[min(int(q * len(xs)), len(xs) - 1)]
 
     def stats(self) -> Dict[str, float]:
         mean = self.sum / self.count if self.count else 0.0
-        return {
+        out = {
             "mean_s": mean,
             "max_s": self.max,
             "min_s": self.min if self.count else 0.0,
             "count": float(self.count),
         }
+        for q, key in _QUANTILES:
+            out[key] = self.quantile(q)
+        return out
 
 
 class ServingMetrics:
@@ -91,6 +116,41 @@ class ServingMetrics:
                 self.counters.get("prefill_tokens", 0) / prefill_t
             )
         return out
+
+    def structured(self) -> dict:
+        """Typed view for exposition formats that distinguish metric
+        kinds (telemetry.prometheus): counters (monotonic, incl. the
+        accumulated-time counters), gauges, derived rates, and timings
+        with reservoir quantiles."""
+        derived = {}
+        decode_t = self._times.get("decode_time_s", 0.0)
+        if decode_t > 0:
+            derived["decode_tokens_per_s"] = (
+                self.counters.get("decode_tokens", 0) / decode_t
+            )
+        prefill_t = self._times.get("prefill_time_s", 0.0)
+        if prefill_t > 0:
+            derived["prefill_tokens_per_s"] = (
+                self.counters.get("prefill_tokens", 0) / prefill_t
+            )
+        return {
+            "counters": {
+                **{k: float(v) for k, v in self.counters.items()},
+                **self._times,
+            },
+            "gauges": dict(self.gauges),
+            "derived": derived,
+            "timings": {
+                name: {
+                    "sum": t.sum,
+                    "count": t.count,
+                    "quantiles": {
+                        str(q): t.quantile(q) for q, _ in _QUANTILES
+                    },
+                }
+                for name, t in self._timings.items()
+            },
+        }
 
     def log_to(self, tracker, step: Optional[int] = None) -> None:
         """Emit the snapshot through a tracking.py tracker (Jsonl/wandb/

@@ -1,0 +1,57 @@
+"""Unified telemetry: spans, goodput ledger, stall watchdog, HBM gauges,
+Prometheus exposition.
+
+The reference has no observability beyond tqdm (SURVEY §5); this package
+is the per-phase time accounting and span-level tracing that turns a
+hung or slow run into a one-line diagnosis (MegaScale NSDI'24, Dapper
+2010 — PAPERS.md "Observability"):
+
+  * ``span("ckpt/save")`` — context-manager spans emitting begin/end
+    records to a crash-safe ``events.jsonl`` (per-line flush) and
+    entering ``jax.named_scope`` so XProf traces carry the same labels;
+  * ``GoodputLedger`` — classifies train-loop wall clock into
+    compile/step/data/checkpoint/eval/sample/log buckets and reports
+    ``goodput_pct`` next to MFU;
+  * ``StallWatchdog`` — heartbeat thread that dumps all-thread stacks
+    (faulthandler) plus a last-spans report when no step completes
+    within a deadline;
+  * ``hbm_gauges`` — per-device HBM occupancy from
+    ``device.memory_stats()``;
+  * ``prometheus_text`` / ``start_prometheus_server`` — text exposition
+    of ``ServingMetrics`` for scraping (file and HTTP).
+
+Everything is CPU-testable; nothing here imports jax at module scope.
+"""
+
+from progen_tpu.telemetry.goodput import BUCKETS, GoodputLedger
+from progen_tpu.telemetry.hbm import hbm_gauges
+from progen_tpu.telemetry.prometheus import (
+    prometheus_text,
+    start_prometheus_server,
+    write_prometheus,
+)
+from progen_tpu.telemetry.spans import (
+    EventLog,
+    Telemetry,
+    configure,
+    get_telemetry,
+    span,
+    step_print,
+)
+from progen_tpu.telemetry.watchdog import StallWatchdog
+
+__all__ = [
+    "BUCKETS",
+    "GoodputLedger",
+    "EventLog",
+    "Telemetry",
+    "configure",
+    "get_telemetry",
+    "span",
+    "step_print",
+    "StallWatchdog",
+    "hbm_gauges",
+    "prometheus_text",
+    "write_prometheus",
+    "start_prometheus_server",
+]

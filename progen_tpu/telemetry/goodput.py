@@ -1,0 +1,81 @@
+"""Goodput ledger: classify every second of loop wall clock.
+
+MegaScale (NSDI'24) frames large-run efficiency as *goodput* — the
+fraction of wall clock the accelerators spend on actual training steps
+— and gets there by accounting for everything else explicitly. This
+ledger is that accounting for one process: the train loop wraps each
+kind of work in ``ledger.track(bucket)`` and ``report()`` divides.
+
+Buckets (``BUCKETS``): ``compile`` (trace+first-step), ``step`` (device
+step dispatch + the host sync that observes it), ``data`` (host input
+pipeline), ``checkpoint``, ``eval``, ``sample``, ``log`` (tracker/
+console IO). Whatever no one claimed lands in ``other`` — the report
+always sums to wall clock exactly, so a low ``coverage_pct`` is itself
+a finding (unattributed time), not a bookkeeping artifact.
+
+MFU says how fast the step is; ``goodput_pct`` says how often the loop
+is actually stepping. Both are needed: a 40%-MFU step inside a
+50%-goodput loop is a 20%-efficient run.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Callable, Dict
+
+BUCKETS = (
+    "compile", "step", "data", "checkpoint", "eval", "sample", "log",
+)
+
+
+class _Tracked:
+    """Handle yielded by ``track`` — ``seconds`` is set on exit so the
+    caller can forward the same measurement elsewhere (e.g.
+    ``StepTimer.exclude``) without re-timing."""
+
+    __slots__ = ("seconds",)
+
+    def __init__(self):
+        self.seconds = 0.0
+
+
+class GoodputLedger:
+    def __init__(self, clock: Callable[[], float] = time.perf_counter):
+        self._clock = clock
+        self._t0 = clock()
+        self._acc: Dict[str, float] = {}
+
+    def account(self, bucket: str, seconds: float) -> None:
+        self._acc[bucket] = self._acc.get(bucket, 0.0) + max(seconds, 0.0)
+
+    @contextlib.contextmanager
+    def track(self, bucket: str):
+        t0 = self._clock()
+        handle = _Tracked()
+        try:
+            yield handle
+        finally:
+            handle.seconds = self._clock() - t0
+            self.account(bucket, handle.seconds)
+
+    @property
+    def wall_s(self) -> float:
+        return self._clock() - self._t0
+
+    def report(self) -> dict:
+        """Flat dict, tracker-loggable. ``bucket_s/*`` (incl. ``other``)
+        sums to ``wall_s`` exactly; ``goodput_pct`` = step share;
+        ``coverage_pct`` = attributed share (the ≥95% health check)."""
+        wall = max(self.wall_s, 1e-9)
+        tracked = sum(self._acc.values())
+        out = {"wall_s": round(wall, 4)}
+        for b in (*BUCKETS, *sorted(set(self._acc) - set(BUCKETS))):
+            if b in self._acc:
+                out[f"bucket_s/{b}"] = round(self._acc[b], 4)
+        out["bucket_s/other"] = round(max(wall - tracked, 0.0), 4)
+        out["goodput_pct"] = round(
+            100.0 * self._acc.get("step", 0.0) / wall, 2
+        )
+        out["coverage_pct"] = round(100.0 * min(tracked / wall, 1.0), 2)
+        return out

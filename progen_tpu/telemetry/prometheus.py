@@ -1,0 +1,112 @@
+"""Prometheus text exposition (format 0.0.4) for ServingMetrics.
+
+Two transports, both fed by the same renderer:
+
+  * ``write_prometheus(path, text)`` — atomic file write (tmp+rename)
+    for the node-exporter *textfile collector* pattern; a scraper never
+    reads a half-written exposition;
+  * ``start_prometheus_server(render_fn)`` — a daemon-thread HTTP
+    server answering every GET with a fresh render; point a Prometheus
+    scrape job at it directly.
+
+The renderer consumes the structured form of
+``serving.metrics.ServingMetrics`` (``structured()``), duck-typed so
+this module stays import-free of the serving package: counters become
+``counter`` samples, gauges ``gauge``, and timings ``summary`` families
+with p50/p95/p99 quantile labels from the reservoir — which is how TTFT
+tails finally become visible on a dashboard instead of only a mean.
+"""
+
+from __future__ import annotations
+
+import http.server
+import os
+import re
+import threading
+from pathlib import Path
+from typing import Callable
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _name(prefix: str, raw: str) -> str:
+    n = _NAME_RE.sub("_", f"{prefix}{raw}")
+    return n if not n[:1].isdigit() else f"_{n}"
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    return repr(int(f)) if f == int(f) else repr(f)
+
+
+def prometheus_text(metrics, prefix: str = "progen_serve_") -> str:
+    """Render a ServingMetrics (anything with ``structured()``) or an
+    already-structured dict to Prometheus exposition text."""
+    s = metrics.structured() if hasattr(metrics, "structured") else metrics
+    lines = []
+    for raw, v in sorted(s.get("counters", {}).items()):
+        n = _name(prefix, raw + "_total")
+        lines += [f"# TYPE {n} counter", f"{n} {_fmt(v)}"]
+    gauges = dict(s.get("gauges", {}))
+    # derived throughputs are gauges too (true rates, not sampled)
+    gauges.update(s.get("derived", {}))
+    for raw, v in sorted(gauges.items()):
+        n = _name(prefix, raw)
+        lines += [f"# TYPE {n} gauge", f"{n} {_fmt(v)}"]
+    for raw, t in sorted(s.get("timings", {}).items()):
+        base = raw[: -len("_s")] if raw.endswith("_s") else raw
+        n = _name(prefix, base + "_seconds")
+        lines.append(f"# TYPE {n} summary")
+        for q, qv in sorted(t.get("quantiles", {}).items()):
+            lines.append(f'{n}{{quantile="{q}"}} {_fmt(qv)}')
+        lines.append(f"{n}_sum {_fmt(t['sum'])}")
+        lines.append(f"{n}_count {_fmt(t['count'])}")
+    return "\n".join(lines) + "\n"
+
+
+def write_prometheus(path, text: str) -> None:
+    """Atomic exposition-file write (textfile-collector contract)."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_name(path.name + ".tmp")
+    tmp.write_text(text)
+    os.replace(tmp, path)
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    render: Callable[[], str]  # set per-server via subclassing
+
+    def do_GET(self):  # noqa: N802 (stdlib handler contract)
+        try:
+            body = type(self).render().encode()
+        except Exception as e:  # a render bug must not kill the server
+            self.send_response(500)
+            self.end_headers()
+            self.wfile.write(repr(e).encode())
+            return
+        self.send_response(200)
+        self.send_header(
+            "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+        )
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # scrapes are not log events
+        pass
+
+
+def start_prometheus_server(
+    render_fn: Callable[[], str], port: int = 0, host: str = "127.0.0.1"
+):
+    """Serve ``render_fn()`` on every GET from a daemon thread. Returns
+    the server; ``server.server_address[1]`` is the bound port (useful
+    with ``port=0``), ``server.shutdown()`` stops it."""
+    handler = type("_BoundHandler", (_Handler,), {"render": staticmethod(render_fn)})
+    srv = http.server.ThreadingHTTPServer((host, port), handler)
+    srv.daemon_threads = True
+    t = threading.Thread(
+        target=srv.serve_forever, name="prometheus-exporter", daemon=True
+    )
+    t.start()
+    return srv

@@ -1,0 +1,166 @@
+"""Span API: context-manager tracing to events.jsonl + jax.named_scope.
+
+A span is one timed region of host code (``span("ckpt/save")``). On
+entry a ``B`` (begin) record goes to the sink; on exit an ``E`` (end)
+record with the duration. Crash forensics fall out of the format: a
+``B`` with no matching ``E`` in ``events.jsonl`` IS the phase the
+process died in — no log-diving required (Dapper-style span trees,
+sized for one process).
+
+Device-side visibility rides the same call: the span body runs under
+``jax.named_scope(name)``, so any op traced inside it carries the span
+name into XProf/TensorBoard timelines. jax is imported lazily and its
+absence is tolerated (pure-host tools can use spans too).
+
+The module-level ``span()``/``configure()`` pair operates a process
+global ``Telemetry`` so deep callees (checkpoint.py, bench phases) can
+open spans without threading a handle through every signature. With no
+sink configured spans still maintain the in-memory recent/open ring
+(what the stall watchdog reports) at ~zero cost.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import json
+import threading
+import time
+from collections import deque
+from pathlib import Path
+from typing import Callable, Optional
+
+
+class EventLog:
+    """Crash-safe append-only JSONL sink: one record per line, flushed
+    per line (same discipline as tracking.JsonlTracker — a SIGKILL at
+    any instant loses at most the line being written, never the file)."""
+
+    def __init__(self, path):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._f = self.path.open("a")
+        self._lock = threading.Lock()
+
+    def emit(self, record: dict) -> None:
+        # the watchdog thread emits concurrently with the main loop; the
+        # lock keeps lines whole (write+flush is one critical section)
+        with self._lock:
+            if self._f.closed:
+                return
+            self._f.write(json.dumps(record) + "\n")
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()
+
+
+def _named_scope(name: str):
+    try:
+        import jax
+
+        return jax.named_scope(name)
+    except Exception:  # jax absent or name rejected: spans still time
+        return contextlib.nullcontext()
+
+
+class Telemetry:
+    """Span emitter + in-memory recent/open span state.
+
+    ``sink`` is any ``callable(dict)`` — an ``EventLog.emit``, a
+    ``JsonlTracker.log_event``, or None (records dropped, ring kept).
+    """
+
+    def __init__(self, sink: Optional[Callable[[dict], None]] = None,
+                 max_recent: int = 64):
+        self._sink = sink
+        self._seq = itertools.count()
+        self._lock = threading.Lock()
+        self._open: dict[int, dict] = {}
+        self._recent: deque = deque(maxlen=max_recent)
+
+    def set_sink(self, sink: Optional[Callable[[dict], None]]) -> None:
+        self._sink = sink
+
+    def emit(self, record: dict) -> None:
+        sink = self._sink
+        if sink is None:
+            return
+        try:
+            sink(record)
+        except (OSError, ValueError):
+            # a closed/broken sink must never take the training loop
+            # down; drop the record and keep the in-memory state
+            self._sink = None
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        sid = next(self._seq)
+        begin = {"ev": "B", "span": name, "id": sid, "ts": time.time()}
+        if attrs:
+            begin.update(attrs)
+        with self._lock:
+            self._open[sid] = begin
+        self.emit(begin)
+        t0 = time.perf_counter()
+        try:
+            with _named_scope(name):
+                yield
+        finally:
+            dur = time.perf_counter() - t0
+            end = {
+                "ev": "E", "span": name, "id": sid,
+                "ts": time.time(), "dur_s": round(dur, 6),
+            }
+            if attrs:
+                end.update(attrs)
+            with self._lock:
+                self._open.pop(sid, None)
+                self._recent.append(end)
+            self.emit(end)
+
+    # ----- watchdog-facing state ------------------------------------------
+
+    def open_spans(self) -> list:
+        """Spans currently inside their body — where the process is NOW."""
+        with self._lock:
+            return sorted(self._open.values(), key=lambda r: r["id"])
+
+    def recent_spans(self, n: int = 16) -> list:
+        """The last ``n`` completed spans, oldest first."""
+        with self._lock:
+            return list(self._recent)[-n:]
+
+
+_GLOBAL = Telemetry()
+
+
+def get_telemetry() -> Telemetry:
+    return _GLOBAL
+
+
+def configure(sink: Optional[Callable[[dict], None]] = None,
+              path=None) -> Telemetry:
+    """Point the process-global telemetry at a sink. ``path`` is a
+    convenience that opens an ``EventLog`` there; ``sink`` wins when both
+    are given; ``configure()`` with neither detaches (spans keep timing,
+    records drop)."""
+    if sink is None and path is not None:
+        sink = EventLog(path).emit
+    _GLOBAL.set_sink(sink)
+    return _GLOBAL
+
+
+def span(name: str, **attrs):
+    """Module-level span on the process-global Telemetry."""
+    return _GLOBAL.span(name, **attrs)
+
+
+def step_print(step, msg: str) -> None:
+    """Step-stamped console line, format-consistent with the tracker
+    stream (the tracker carries ``_time``/``_step``; the console carries
+    the same two, human-readable): ``[HH:MM:SS step N] msg``."""
+    stamp = time.strftime("%H:%M:%S")
+    print(f"[{stamp} step {step}] {msg}")

@@ -55,6 +55,9 @@ class NoopTracker:
     def log(self, metrics: dict, step: Optional[int] = None) -> None:
         pass
 
+    def log_event(self, record: dict) -> None:
+        pass
+
     def log_html(self, name: str, html: str, step: Optional[int] = None) -> None:
         pass
 
@@ -71,6 +74,7 @@ class JsonlTracker(NoopTracker):
         self.path = Path(dir) / project / self.run_id
         self.path.mkdir(parents=True, exist_ok=True)
         self._metrics = (self.path / "metrics.jsonl").open("a")
+        self._events = None  # opened on first span; most runs have none
 
     def log(self, metrics: dict, step: Optional[int] = None) -> None:
         rec = {"_time": time.time(), **metrics}
@@ -78,6 +82,17 @@ class JsonlTracker(NoopTracker):
             rec["_step"] = step
         self._metrics.write(json.dumps(rec) + "\n")
         self._metrics.flush()
+
+    def log_event(self, record: dict) -> None:
+        """Span/watchdog records -> events.jsonl beside metrics.jsonl,
+        same crash-safety discipline (flush per line). Raises ValueError
+        after ``finish()`` — telemetry sinks treat that as detach."""
+        if self._events is None:
+            if self._metrics.closed:
+                raise ValueError("tracker is finished")
+            self._events = (self.path / "events.jsonl").open("a")
+        self._events.write(json.dumps(record) + "\n")
+        self._events.flush()
 
     def log_html(self, name: str, html: str, step: Optional[int] = None) -> None:
         suffix = f"_{step}" if step is not None else ""
@@ -88,6 +103,8 @@ class JsonlTracker(NoopTracker):
 
     def finish(self) -> None:
         self._metrics.close()
+        if self._events is not None:
+            self._events.close()
 
 
 class WandbTracker(NoopTracker):  # exercised via a mock module in-suite

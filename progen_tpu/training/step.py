@@ -67,6 +67,8 @@ def make_train_step(
     """
 
     def train_step(state: TrainState, batch: jnp.ndarray):
+        # named_scope labels land in XProf/TensorBoard traces, so a
+        # profile splits cleanly into grads vs optimizer time
         with nn.logical_axis_rules(rules):
             grad_fn = jax.value_and_grad(
                 lambda p, mb: batch_loss(model, p, mb, forward_fn)
@@ -77,14 +79,16 @@ def make_train_step(
                 grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
                 return grads_acc, loss
 
-            zero_grads = jax.tree.map(jnp.zeros_like, state.params)
-            grads, losses = jax.lax.scan(micro, zero_grads, batch)
-            grads = jax.tree.map(lambda g: g / batch.shape[0], grads)
+            with jax.named_scope("microbatch_grads"):
+                zero_grads = jax.tree.map(jnp.zeros_like, state.params)
+                grads, losses = jax.lax.scan(micro, zero_grads, batch)
+                grads = jax.tree.map(lambda g: g / batch.shape[0], grads)
 
-            updates, opt_state = optimizer.update(
-                grads, state.opt_state, state.params
-            )
-            params = optax.apply_updates(state.params, updates)
+            with jax.named_scope("optimizer_update"):
+                updates, opt_state = optimizer.update(
+                    grads, state.opt_state, state.params
+                )
+                params = optax.apply_updates(state.params, updates)
             new_state = state.replace(
                 step=state.step + 1, params=params, opt_state=opt_state
             )
@@ -104,7 +108,7 @@ def make_eval_step(model, rules=DEFAULT_RULES):
     a forward-only program."""
 
     def eval_step(state: TrainState, data: jnp.ndarray):
-        with nn.logical_axis_rules(rules):
+        with nn.logical_axis_rules(rules), jax.named_scope("eval_forward"):
             return batch_loss(model, state.params, data)
 
     return eval_step

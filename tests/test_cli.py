@@ -317,3 +317,60 @@ def test_eval_cli(workspace, monkeypatch):
     loss = float(res.output.split("loss: ")[1].split()[0])
     ppl = float(res.output.split("perplexity: ")[1].split()[0])
     np.testing.assert_allclose(ppl, np.exp(loss), rtol=1e-4)
+
+
+def test_train_telemetry_events(workspace, monkeypatch):
+    """Acceptance for the telemetry layer: a CPU train run through the
+    real CLI (JsonlTracker, not --wandb_off) leaves an events.jsonl span
+    trail and a goodput record whose buckets sum to wall clock with
+    >=95% attributed."""
+    import json
+    import sys
+
+    monkeypatch.chdir(workspace)
+    # force the JsonlTracker path deterministically: wandb unimportable
+    monkeypatch.setitem(sys.modules, "wandb", None)
+    runner = CliRunner()
+
+    from progen_tpu.cli.train import main as train_main
+
+    res = runner.invoke(train_main, [
+        "--batch_size", "4", "--grad_accum_every", "1",
+        "--num_steps", "2", "--validate_every", "1", "--sample_every", "100",
+        "--checkpoint_every", "1", "--seq_len", "32",
+        "--config_path", str(workspace / "configs" / "model"),
+        "--data_path", str(workspace / "train_data"),
+        "--checkpoint_path", str(workspace / "ckpts_telemetry"),
+    ])
+    assert res.exit_code == 0, res.output
+    assert "goodput:" in res.output
+    assert "step " in res.output  # step-stamped lines, not bare prints
+
+    runs = sorted((workspace / "runs" / "progen-training").iterdir())
+    assert runs, "JsonlTracker run dir missing"
+    run_dir = runs[-1]
+
+    events = [
+        json.loads(l)
+        for l in (run_dir / "events.jsonl").read_text().splitlines()
+    ]
+    spans = {r["span"] for r in events if r.get("ev") == "B"}
+    assert "train/compile" in spans
+    assert "ckpt/save" in spans
+    # every span opened in a completed run also closed
+    opened = [r["id"] for r in events if r.get("ev") == "B"]
+    closed = [r["id"] for r in events if r.get("ev") == "E"]
+    assert sorted(opened) == sorted(closed)
+
+    metrics = [
+        json.loads(l)
+        for l in (run_dir / "metrics.jsonl").read_text().splitlines()
+    ]
+    goodput = [m for m in metrics if "goodput_pct" in m]
+    assert goodput, "no goodput record logged"
+    rep = goodput[-1]
+    bucket_total = sum(
+        v for k, v in rep.items() if k.startswith("bucket_s/")
+    )
+    assert bucket_total == pytest.approx(rep["wall_s"], rel=0.01)
+    assert rep["coverage_pct"] >= 95.0

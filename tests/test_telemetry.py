@@ -1,0 +1,348 @@
+"""Telemetry layer: spans, goodput ledger, stall watchdog, HBM gauges,
+Prometheus exposition — plus the crash-safety contract of the jsonl
+sinks (a SIGKILL'd run leaves fully parseable files) and the end-to-end
+acceptance: a CPU train run emits span events and a goodput record
+whose buckets sum to wall clock with >=95% attributed."""
+
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from progen_tpu.telemetry import (
+    BUCKETS,
+    EventLog,
+    GoodputLedger,
+    StallWatchdog,
+    Telemetry,
+    hbm_gauges,
+    prometheus_text,
+    start_prometheus_server,
+    step_print,
+    write_prometheus,
+)
+
+
+# ---------------------------------------------------------------- spans
+
+
+def test_span_emits_begin_end_records(tmp_path):
+    log = EventLog(tmp_path / "events.jsonl")
+    tel = Telemetry(sink=log.emit)
+    with tel.span("ckpt/save", step=7):
+        pass
+    log.close()
+    recs = [
+        json.loads(l) for l in (tmp_path / "events.jsonl").read_text().splitlines()
+    ]
+    assert [r["ev"] for r in recs] == ["B", "E"]
+    assert all(r["span"] == "ckpt/save" and r["step"] == 7 for r in recs)
+    assert recs[0]["id"] == recs[1]["id"]
+    assert recs[1]["dur_s"] >= 0.0
+
+
+def test_open_span_visible_until_exit():
+    tel = Telemetry()
+    with tel.span("outer"):
+        with tel.span("inner"):
+            names = [r["span"] for r in tel.open_spans()]
+            assert names == ["outer", "inner"]
+        assert [r["span"] for r in tel.open_spans()] == ["outer"]
+    assert tel.open_spans() == []
+    assert [r["span"] for r in tel.recent_spans()] == ["inner", "outer"]
+
+
+def test_span_closes_on_exception():
+    tel = Telemetry()
+    with pytest.raises(RuntimeError):
+        with tel.span("doomed"):
+            raise RuntimeError("boom")
+    assert tel.open_spans() == []
+    assert tel.recent_spans()[-1]["span"] == "doomed"
+
+
+def test_broken_sink_detaches_instead_of_raising(tmp_path):
+    log = EventLog(tmp_path / "ev.jsonl")
+    tel = Telemetry(sink=log.emit)
+    log._f.close()  # simulate the fd dying under the sink
+    with tel.span("survives"):  # must not raise
+        pass
+    assert tel.recent_spans()[-1]["span"] == "survives"
+
+
+def test_step_print_format(capsys):
+    step_print(42, "loss: 1.2345")
+    out = capsys.readouterr().out
+    assert "step 42]" in out and "loss: 1.2345" in out
+
+
+# -------------------------------------------------------------- goodput
+
+
+def test_goodput_buckets_sum_to_wallclock():
+    t = {"now": 0.0}
+    ledger = GoodputLedger(clock=lambda: t["now"])
+    for bucket, dur in (
+        ("compile", 5.0), ("step", 30.0), ("data", 2.0),
+        ("checkpoint", 3.0), ("eval", 1.5), ("sample", 1.0), ("log", 0.5),
+    ):
+        with ledger.track(bucket):
+            t["now"] += dur
+    t["now"] += 2.0  # unattributed tail
+    rep = ledger.report()
+    total = sum(v for k, v in rep.items() if k.startswith("bucket_s/"))
+    assert total == pytest.approx(rep["wall_s"], abs=1e-6)
+    assert rep["bucket_s/other"] == pytest.approx(2.0)
+    assert rep["goodput_pct"] == pytest.approx(100 * 30.0 / 45.0, abs=0.01)
+    assert rep["coverage_pct"] == pytest.approx(100 * 43.0 / 45.0, abs=0.01)
+    assert set(BUCKETS) == {
+        "compile", "step", "data", "checkpoint", "eval", "sample", "log"
+    }
+
+
+def test_goodput_track_handle_reports_seconds():
+    t = {"now": 0.0}
+    ledger = GoodputLedger(clock=lambda: t["now"])
+    with ledger.track("checkpoint") as tr:
+        t["now"] += 4.0
+    assert tr.seconds == pytest.approx(4.0)
+
+
+# ------------------------------------------------------------- watchdog
+
+
+def test_watchdog_fires_with_stack_dump_and_spans():
+    buf = io.StringIO()
+    tel = Telemetry()
+    reports = []
+    with tel.span("train/step"):
+        wd = StallWatchdog(
+            0.2, file=buf, telemetry=tel, on_stall=reports.append,
+            poll_s=0.05,
+        )
+        with wd:
+            deadline = time.time() + 5.0
+            while not wd.fired and time.time() < deadline:
+                time.sleep(0.05)
+    assert wd.fired and wd.fire_count == 1  # once per stall, not per poll
+    out = buf.getvalue()
+    assert "stall-watchdog" in out
+    assert "train/step" in out
+    # faulthandler's all-thread dump names this (the main) thread
+    assert "Current thread" in out or "Thread" in out
+    assert reports and reports[0]["open_spans"][0]["span"] == "train/step"
+
+
+def test_watchdog_does_not_fire_while_beaten():
+    buf = io.StringIO()
+    wd = StallWatchdog(0.4, file=buf, telemetry=Telemetry(), poll_s=0.05)
+    with wd:
+        for _ in range(12):  # 0.6s of steady heartbeats < deadline apart
+            wd.beat()
+            time.sleep(0.05)
+    assert not wd.fired
+    assert buf.getvalue() == ""
+
+
+def test_watchdog_rejects_nonpositive_deadline():
+    with pytest.raises(ValueError):
+        StallWatchdog(0)
+
+
+# ------------------------------------------------------------------ hbm
+
+
+def test_hbm_gauges_degrade_to_empty_or_gb_floats():
+    g = hbm_gauges()  # CPU backend in-suite: usually {}
+    assert isinstance(g, dict)
+    for k, v in g.items():
+        assert k.startswith("hbm/") and isinstance(v, float)
+
+
+def test_hbm_gauges_from_fake_device():
+    class Dev:
+        def memory_stats(self):
+            return {
+                "bytes_in_use": 2**30,
+                "peak_bytes_in_use": 2 * 2**30,
+                "bytes_limit": 4 * 2**30,
+            }
+
+    g = hbm_gauges(Dev())
+    assert g["hbm/in_use_gb"] == 1.0
+    assert g["hbm/peak_gb"] == 2.0
+    assert g["hbm/limit_gb"] == 4.0
+    assert g["hbm/used_pct"] == 25.0
+
+
+# ----------------------------------------------------------- prometheus
+
+
+def _metrics_with_tail():
+    from progen_tpu.serving.metrics import ServingMetrics
+
+    m = ServingMetrics()
+    m.inc("requests_completed", 100)
+    m.set_gauge("queue_depth", 3)
+    for i in range(100):
+        m.observe("ttft_s", 0.01 * (i + 1))
+    m.add_time("decode_time_s", 2.0)
+    m.inc("decode_tokens", 500)
+    return m
+
+
+def test_prometheus_text_format():
+    text = prometheus_text(_metrics_with_tail())
+    assert "# TYPE progen_serve_requests_completed_total counter" in text
+    assert "# TYPE progen_serve_queue_depth gauge" in text
+    assert "# TYPE progen_serve_ttft_seconds summary" in text
+    assert 'progen_serve_ttft_seconds{quantile="0.99"}' in text
+    assert "progen_serve_ttft_seconds_count 100" in text
+    assert "progen_serve_decode_tokens_per_s 250" in text
+    assert text.endswith("\n")
+
+
+def test_write_prometheus_atomic(tmp_path):
+    p = tmp_path / "metrics" / "serve.prom"
+    write_prometheus(p, "a 1\n")
+    write_prometheus(p, "a 2\n")
+    assert p.read_text() == "a 2\n"
+    assert not p.with_name(p.name + ".tmp").exists()
+
+
+def test_prometheus_http_server():
+    m = _metrics_with_tail()
+    srv = start_prometheus_server(lambda: prometheus_text(m), port=0)
+    try:
+        port = srv.server_address[1]
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ).read().decode()
+        assert 'progen_serve_ttft_seconds{quantile="0.99"}' in body
+    finally:
+        srv.shutdown()
+
+
+# --------------------------------------------- serving metrics quantiles
+
+
+def test_timing_reservoir_quantiles():
+    from progen_tpu.serving.metrics import ServingMetrics
+
+    m = ServingMetrics()
+    for i in range(1000):
+        m.observe("lat_s", float(i))  # > reservoir cap: sampled tail
+    s = m.snapshot()
+    assert s["lat_s_p50_s"] == pytest.approx(500, abs=100)
+    assert s["lat_s_p95_s"] == pytest.approx(950, abs=60)
+    assert s["lat_s_p99_s"] == pytest.approx(990, abs=40)
+    assert s["lat_s_mean_s"] == pytest.approx(499.5)
+    # pre-existing snapshot keys stay intact
+    assert {"lat_s_min_s", "lat_s_max_s", "lat_s_count"} <= set(s)
+
+
+def test_timing_quantiles_deterministic():
+    from progen_tpu.serving.metrics import _Timing
+
+    a, b = _Timing(), _Timing()
+    for i in range(2000):
+        a.observe(float(i))
+        b.observe(float(i))
+    assert a.quantile(0.99) == b.quantile(0.99)
+
+
+# ------------------------------------------------------ StepTimer fixes
+
+
+def test_step_timer_exclude_removes_cadence_time(monkeypatch):
+    from progen_tpu import profiling
+
+    t = {"now": 0.0}
+    monkeypatch.setattr(profiling.time, "perf_counter", lambda: t["now"])
+    timer = profiling.StepTimer(
+        n_chips=1, flops_per_tok=1, peak=1.0, warmup=0
+    )
+    timer.tick(10)  # arm
+    t["now"] += 1.0
+    assert timer.tick(10)["step_ms"] == pytest.approx(1000.0)
+    # a 5s checkpoint between ticks must NOT count as step time
+    t["now"] += 5.0
+    timer.exclude(5.0)
+    t["now"] += 1.0
+    assert timer.tick(10)["step_ms"] == pytest.approx(1000.0)
+    # exclusion is consumed; the next tick is unaffected
+    t["now"] += 2.0
+    assert timer.tick(10)["step_ms"] == pytest.approx(2000.0)
+
+
+# ------------------------------------------------- jsonl crash-safety
+
+
+_KILL_SCRIPT = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    from progen_tpu.tracking import JsonlTracker
+    from progen_tpu import telemetry
+
+    tr = JsonlTracker("proj", "runA", {dir!r})
+    telemetry.configure(sink=tr.log_event)
+    i = 0
+    while True:
+        tr.log({{"loss": 1.0, "i": i}}, step=i)
+        with telemetry.span("work", i=i):
+            pass
+        i += 1
+        if i == 50:
+            print("GO", flush=True)
+""")
+
+
+def test_sigkill_leaves_parseable_jsonl(tmp_path):
+    """SIGKILL mid-write may truncate the LAST line of each file; every
+    complete line must parse and earlier records must all be present."""
+    repo = str(Path(__file__).resolve().parent.parent)
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         _KILL_SCRIPT.format(repo=repo, dir=str(tmp_path))],
+        stdout=subprocess.PIPE,
+    )
+    assert proc.stdout.readline().strip() == b"GO"  # >=50 records written
+    time.sleep(0.05)  # let it keep writing so the kill lands mid-stream
+    os.kill(proc.pid, signal.SIGKILL)
+    proc.wait(timeout=10)
+
+    for name, min_recs in (("metrics.jsonl", 50), ("events.jsonl", 100)):
+        raw = (tmp_path / "proj" / "runA" / name).read_bytes()
+        lines = raw.split(b"\n")
+        complete, last = lines[:-1], lines[-1]
+        recs = [json.loads(l) for l in complete if l.strip()]
+        assert len(recs) >= min_recs, f"{name}: lost flushed records"
+        # only the final (killed mid-write) line may be partial
+        if last:
+            with pytest.raises(json.JSONDecodeError):
+                json.loads(last)
+
+
+def test_tracker_log_event_writes_events_jsonl(tmp_path):
+    from progen_tpu.tracking import JsonlTracker
+
+    tr = JsonlTracker("proj", "runB", str(tmp_path))
+    tr.log_event({"ev": "B", "span": "x"})
+    tr.finish()
+    recs = [
+        json.loads(l)
+        for l in (tmp_path / "proj" / "runB" / "events.jsonl")
+        .read_text().splitlines()
+    ]
+    assert recs == [{"ev": "B", "span": "x"}]
+    with pytest.raises(ValueError):
+        tr.log_event({"ev": "E"})  # after finish: sink contract = raise
