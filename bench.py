@@ -1754,8 +1754,12 @@ if __name__ == "__main__":
             # it only fires when the progress trail actually stops.
             from progen_tpu.telemetry import StallWatchdog
 
+            # escalate_after=2: if the stall survives two reports, the
+            # third event snapshots device memory_stats + open spans —
+            # the forensic record the SIGALRM kill would otherwise eat
             _WATCHDOG = StallWatchdog(
-                max(60.0, deadline * 0.6), file=sys.stderr
+                max(60.0, deadline * 0.6), file=sys.stderr,
+                escalate_after=2,
             ).start()
         try:
             if os.environ.get("BENCH_REQUIRE_TPU") == "1":

@@ -26,7 +26,10 @@ TPU-first deltas:
 
 from __future__ import annotations
 
+import hashlib
 import json
+import os
+import re
 import shutil
 import time
 from pathlib import Path
@@ -37,9 +40,79 @@ import orbax.checkpoint as ocp
 from jax.sharding import NamedSharding
 
 from progen_tpu import telemetry
+from progen_tpu.resilience.retry import retry_call
 
 CKPT_PREFIX = "ckpt_"
+CORRUPT_SUFFIX = ".corrupt"
+_CKPT_NAME_RE = re.compile(re.escape(CKPT_PREFIX) + r"\d+")
 DEFAULT_KEEP_LAST_N = 500  # reference default, train.py:48
+
+
+# ---------------------------------------------------------------------------
+# Integrity manifest: per-entry digests riding meta.json
+# ---------------------------------------------------------------------------
+#
+# A checkpoint is only as good as its worst byte: Orbax's tmp+rename
+# commit protects against dying MID-write, but not against truncation,
+# bit rot, or a partially-synced network filesystem discovered at
+# restore time — which used to be discovered as an opaque TensorStore
+# error that killed the run. The manifest records (size, sha256) for
+# every file under ``state/`` at save time; restore verifies it and
+# walks BACKWARD through older complete checkpoints when it fails,
+# renaming the bad directory to ``ckpt_N.corrupt`` (quarantine, never
+# delete — the evidence matters) instead of crashing.
+#
+# Local-path only: digesting a gs:// checkpoint means re-downloading it.
+# Env gates: PROGEN_CKPT_DIGEST=0 skips writing manifests,
+# PROGEN_CKPT_VERIFY=0 skips verification (both default on).
+
+
+def _digest_enabled() -> bool:
+    return os.environ.get("PROGEN_CKPT_DIGEST", "1") != "0"
+
+
+def _verify_enabled() -> bool:
+    return os.environ.get("PROGEN_CKPT_VERIFY", "1") != "0"
+
+
+def digest_manifest(state_dir) -> Optional[dict]:
+    """{relpath: [size, sha256hex]} for every file under ``state_dir``;
+    None for non-local paths (CloudPath) or when digests are disabled."""
+    if not _digest_enabled() or not isinstance(state_dir, Path):
+        return None
+    manifest = {}
+    for p in sorted(state_dir.rglob("*")):
+        if not p.is_file():
+            continue
+        h = hashlib.sha256()
+        with p.open("rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        rel = p.relative_to(state_dir).as_posix()
+        manifest[rel] = [p.stat().st_size, h.hexdigest()]
+    return manifest
+
+
+def verify_manifest(state_dir, manifest: Optional[dict]) -> bool:
+    """True when every manifest entry exists with matching size+digest.
+    A legacy checkpoint (no manifest) verifies trivially; extra files on
+    disk are tolerated (forward compat with Orbax layout changes)."""
+    if not manifest or not isinstance(state_dir, Path):
+        return True
+    for rel, (size, digest) in manifest.items():
+        p = state_dir / rel
+        try:
+            if p.stat().st_size != int(size):
+                return False
+            h = hashlib.sha256()
+            with p.open("rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+            if h.hexdigest() != digest:
+                return False
+        except OSError:
+            return False
+    return True
 
 
 class Package(NamedTuple):
@@ -112,8 +185,15 @@ def get_checkpoint_fns(
     def _list() -> list:
         if not _exists(root):
             return []
+        # fullmatch excludes quarantined ``ckpt_N.corrupt`` dirs — they
+        # stay on disk as evidence but never re-enter the rotation (and
+        # never confuse the stamp arithmetic in _save)
         return sorted(
-            (p for p in root.iterdir() if p.name.startswith(CKPT_PREFIX)),
+            (
+                p
+                for p in root.iterdir()
+                if _CKPT_NAME_RE.fullmatch(p.name)
+            ),
             key=lambda p: p.name,
         )
 
@@ -160,7 +240,15 @@ def get_checkpoint_fns(
             item = _async.pop("pending", None)
             if item is not None and jax.process_index() == 0:
                 target, meta = item
-                _write_text(target / "meta.json", json.dumps(meta))
+                # arrays are fully committed now — digest them before
+                # the manifest-bearing meta.json publishes the checkpoint
+                meta["integrity"] = digest_manifest(target / "state")
+                retry_call(
+                    _write_text,
+                    target / "meta.json",
+                    json.dumps(meta),
+                    label="ckpt/io/meta_write",
+                )
                 _retain()
 
     def _close() -> None:
@@ -220,12 +308,31 @@ def get_checkpoint_fns(
             )
             _async["pending"] = (target, meta)
             return str(target)
-        with ocp.StandardCheckpointer() as ckptr:
-            ckptr.save(target / "state", package.state)  # collective
+
+        def _commit():
+            # a failed earlier attempt can leave a partial target that
+            # Orbax refuses to overwrite — clear it before re-trying
+            state_dir = target / "state"
+            if isinstance(state_dir, Path) and state_dir.exists():
+                shutil.rmtree(state_dir)
+            with ocp.StandardCheckpointer() as ckptr:
+                ckptr.save(state_dir, package.state)  # collective
+
+        if jax.process_count() > 1:
+            _commit()  # collective op: per-host retry would deadlock
+        else:
+            retry_call(_commit, label="ckpt/io/save")
         if jax.process_index() == 0:
             # metadata written after the state commit; a checkpoint without
-            # meta.json is treated as incomplete and skipped on restore
-            _write_text(target / "meta.json", json.dumps(meta))
+            # meta.json is treated as incomplete and skipped on restore.
+            # The integrity manifest digests what actually hit storage.
+            meta["integrity"] = digest_manifest(target / "state")
+            retry_call(
+                _write_text,
+                target / "meta.json",
+                json.dumps(meta),
+                label="ckpt/io/meta_write",
+            )
             _retain()
         return str(target)
 
@@ -241,14 +348,79 @@ def get_checkpoint_fns(
     def _complete(candidates):
         return [p for p in candidates if _exists(p / "meta.json")]
 
+    def _quarantine(p, reason: str) -> None:
+        """Rename a bad checkpoint dir to ``<name>.corrupt`` so it leaves
+        the rotation but stays on disk as evidence. Coordinator-only (on a
+        shared filesystem every host sees the rename); best-effort — a
+        failed rename just means the next walk re-discovers the same
+        verdict."""
+        import jax
+
+        print(
+            f"[checkpoint] quarantining {getattr(p, 'name', p)}: {reason}",
+            flush=True,
+        )
+        telemetry.get_telemetry().emit({
+            "ev": "ckpt_quarantine",
+            "ts": time.time(),
+            "ckpt": getattr(p, "name", str(p)),
+            "reason": reason,
+        })
+        if jax.process_index() != 0 or not isinstance(p, Path):
+            return
+        try:
+            p.rename(p.with_name(p.name + CORRUPT_SUFFIX))
+        except OSError:
+            pass
+
+    # checkpoints whose manifest verified this process — peek_last and a
+    # following get_last hash the same bytes once, not twice
+    _verified: set = set()
+
+    def _select_last() -> Optional[tuple]:
+        """Newest COMPLETE checkpoint whose integrity manifest verifies,
+        walking backward through older ones and quarantining failures —
+        the fallback chain replacing the old newest-or-crash behavior.
+        Returns (dir, meta) or None."""
+        for cand in reversed(_complete(_list())):
+            try:
+                meta = json.loads(
+                    retry_call(
+                        _read_text,
+                        cand / "meta.json",
+                        label="ckpt/io/meta_read",
+                    )
+                )
+            except (OSError, ValueError):
+                _quarantine(cand, "unreadable meta.json")
+                continue
+            if _verify_enabled() and cand.name not in _verified:
+                if not verify_manifest(cand / "state", meta.get("integrity")):
+                    _quarantine(cand, "integrity manifest mismatch")
+                    continue
+                _verified.add(cand.name)
+            return cand, meta
+        return None
+
     def _get_last(abstract_state: Any = None) -> Optional[Package]:
-        candidates = _complete(_list())
-        if not candidates:
+        import jax
+
+        sel = _select_last()
+        if sel is None:
             return None
-        last = candidates[-1]
-        meta = json.loads(_read_text(last / "meta.json"))
-        with ocp.StandardCheckpointer() as ckptr:
-            state = ckptr.restore(last / "state", abstract_state)
+        last, meta = sel
+
+        def _restore():
+            with ocp.StandardCheckpointer() as ckptr:
+                return ckptr.restore(last / "state", abstract_state)
+
+        # a restore failure on a digest-verified checkpoint is structural
+        # (template mismatch), not corruption — re-raise, don't walk: a
+        # silent fallback would mask a real bug with stale weights
+        if jax.process_count() > 1:
+            state = _restore()  # collective: per-host retry would deadlock
+        else:
+            state = retry_call(_restore, label="ckpt/io/restore")
         return Package(
             next_seq_index=meta["next_seq_index"],
             state=state,
@@ -266,11 +438,10 @@ def get_checkpoint_fns(
         moments — ~2/3 of the checkpoint bytes, which matters at 1.2B on a
         small sampling box. ``state`` in the returned Package is just the
         params pytree."""
-        candidates = _complete(_list())
-        if not candidates:
+        sel = _select_last()
+        if sel is None:
             return None
-        last = candidates[-1]
-        meta = json.loads(_read_text(last / "meta.json"))
+        last, meta = sel
         with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ckptr:
             if abstract_params is None:
                 # shape/dtype skeleton from the checkpoint's own metadata,
@@ -338,11 +509,14 @@ def get_checkpoint_fns(
     def peek_last() -> Optional[Package]:
         """Metadata only (state=None) — decide model config / resume point
         without paying the array restore (train.py:94-100 reads only the
-        config before building the model)."""
-        candidates = _complete(_list())
-        if not candidates:
+        config before building the model). Runs the same verify+fallback
+        walk as get_last (cached, so the bytes hash once) — otherwise the
+        model could be built from a config whose checkpoint get_last later
+        quarantines."""
+        sel = _select_last()
+        if sel is None:
             return None
-        meta = json.loads(_read_text(candidates[-1] / "meta.json"))
+        _, meta = sel
         return Package(
             next_seq_index=meta["next_seq_index"],
             state=None,

@@ -71,6 +71,8 @@ def _parse_request(line, defaults):
             top_p=(None if obj.get("top_p", defaults["top_p"]) is None
                    else float(obj.get("top_p", defaults["top_p"]))),
             seed=int(obj.get("seed", defaults["seed"])),
+            deadline_s=(None if obj.get("deadline_s") is None
+                        else float(obj["deadline_s"])),
         )
         return req, None
     except (ValueError, TypeError) as e:
@@ -196,13 +198,39 @@ def main(checkpoint_path, max_slots, max_queue, max_len, top_k,
         f"max_queue={sched.max_queue}",
         file=sys.stderr,
     )
+
+    # graceful drain: the FIRST SIGTERM/SIGINT closes intake — queued
+    # requests are shed as 'rejected: draining', in-flight slots decode
+    # to completion, metrics flush, exit 0 (what a rolling restart
+    # wants). A SECOND signal means "now": exit immediately.
+    import signal
+
+    shutdown = {"flag": False}
+
+    def _request_drain(signum, frame):
+        if shutdown["flag"]:
+            print(f"signal {signum} again: exiting now", file=sys.stderr)
+            sys.stderr.flush()
+            os._exit(1)
+        shutdown["flag"] = True
+        print(
+            f"signal {signum}: draining — intake closed, finishing "
+            "in-flight requests; signal again to kill",
+            file=sys.stderr,
+        )
+
+    old_term = signal.signal(signal.SIGTERM, _request_drain)
+    old_int = signal.signal(signal.SIGINT, _request_drain)
     try:
         if socket_path:
             _serve_socket(sched, defaults, socket_path, publish,
-                          metrics_every)
+                          metrics_every, shutdown)
         else:
-            _serve_stdio(sched, defaults, publish, metrics_every)
+            _serve_stdio(sched, defaults, publish, metrics_every,
+                         shutdown)
     finally:
+        signal.signal(signal.SIGTERM, old_term)
+        signal.signal(signal.SIGINT, old_int)
         publish()
         if prom_srv is not None:
             prom_srv.shutdown()
@@ -226,13 +254,36 @@ def _submit_line(sched, line, defaults):
     return None, req
 
 
-def _serve_stdio(sched, defaults, publish, metrics_every):
+def _shed_lines(sched, starts, owners=None):
+    """Requests the scheduler shed (deadline expiry, drain) become
+    rejection events for their owners; returns (fd_or_None, line)
+    pairs — fd is None on the stdio transport."""
+    out = []
+    for req, reason in sched.pop_expired():
+        starts.pop(req.id, None)
+        if owners is None:
+            out.append((None, json.dumps({
+                "event": "rejected", "id": req.id, "reason": reason,
+            })))
+        else:
+            fd, public = owners.pop(req.id, (None, None))
+            if fd is not None:
+                out.append((fd, json.dumps({
+                    "event": "rejected", "id": public, "reason": reason,
+                })))
+    return out
+
+
+def _serve_stdio(sched, defaults, publish, metrics_every, shutdown):
     """stdin-JSONL transport: poll stdin between decode steps so new
     requests join mid-flight (continuous batching, not read-all-then-
-    drain); EOF stops intake and the loop drains what remains."""
+    drain); EOF stops intake and the loop drains what remains. A drain
+    signal (see main) also stops intake, but sheds the QUEUE — only
+    in-flight slots run to completion."""
     starts = {}
     out = sys.stdout
     eof = False
+    drained = False
     steps = 0
 
     def emit(lines):
@@ -240,11 +291,18 @@ def _serve_stdio(sched, defaults, publish, metrics_every):
             out.write(ln + "\n")
         out.flush()
 
-    while not eof or sched.has_work:
-        # take every line already waiting; block for input only when idle
-        while not eof:
-            timeout = None if not sched.has_work else 0.0
-            ready, _, _ = select.select([sys.stdin], [], [], timeout)
+    while (not eof and not shutdown["flag"]) or sched.has_work:
+        if shutdown["flag"] and not drained:
+            drained = True
+            sched.drain_queue()
+        # take every line already waiting; bounded idle wait (not a full
+        # block) so a drain signal interrupts within one tick
+        while not eof and not shutdown["flag"]:
+            timeout = 0.2 if not sched.has_work else 0.0
+            try:
+                ready, _, _ = select.select([sys.stdin], [], [], timeout)
+            except OSError:
+                break
             if not ready:
                 break
             line = sys.stdin.readline()
@@ -264,12 +322,18 @@ def _serve_stdio(sched, defaults, publish, metrics_every):
             steps += 1
             if metrics_every and steps % metrics_every == 0:
                 publish(steps)
+        # requests shed this tick (deadline expiry inside step(), or the
+        # drain above) surface as rejection events
+        emit([ln for _, ln in _shed_lines(sched, starts)])
 
 
-def _serve_socket(sched, defaults, socket_path, publish, metrics_every):
+def _serve_socket(sched, defaults, socket_path, publish, metrics_every,
+                  shutdown):
     """Unix-socket transport: one select loop over {listener, clients,
     engine}; request ids are namespaced per connection internally so two
-    clients may both call their request "1"."""
+    clients may both call their request "1". On drain the listener
+    closes (new connections refused), the queue is shed, in-flight
+    slots finish streaming to their clients, then the loop exits."""
     if os.path.exists(socket_path):
         os.unlink(socket_path)
     srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
@@ -297,11 +361,29 @@ def _serve_socket(sched, defaults, socket_path, publish, metrics_every):
         if sock is not None:
             sock.close()
 
+    drained = False
     try:
         while True:
-            rlist = [srv] + [s for s, _ in clients.values()]
+            if shutdown["flag"]:
+                if not drained:
+                    drained = True
+                    srv.close()  # refuse new connections during drain
+                    sched.drain_queue()
+                    for fd, ln in _shed_lines(sched, starts, owners):
+                        send(fd, [ln])
+                if not sched.has_work:
+                    break
+            rlist = ([] if drained else [srv]) + [
+                s for s, _ in clients.values()
+            ]
             timeout = 0.0 if sched.has_work else 0.2
-            ready, _, _ = select.select(rlist, [], [], timeout)
+            try:
+                ready, _, _ = (
+                    select.select(rlist, [], [], timeout)
+                    if rlist else ([], [], [])
+                )
+            except OSError:
+                continue  # a peer vanished between list and select
             for sock in ready:
                 if sock is srv:
                     conn, _ = srv.accept()
@@ -344,6 +426,8 @@ def _serve_socket(sched, defaults, socket_path, publish, metrics_every):
                     })])
             if sched.has_work:
                 events, comps = sched.step()
+                for fd, ln in _shed_lines(sched, starts, owners):
+                    send(fd, [ln])
                 for ev in events:
                     fd, public = owners.get(ev.request_id, (None, None))
                     if fd is None:

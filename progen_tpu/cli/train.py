@@ -33,6 +33,13 @@ def confirm(question: str) -> bool:
     return input(f"{question} (y/n) ").strip().lower() == "y"
 
 
+class AnomalyRollback(Exception):
+    """Raised by the metrics flush when the loss sentinel escalates
+    (``patience`` consecutive anomalies); caught by the train loop, which
+    restores the last good checkpoint and skips ahead in the data stream
+    past the offending window."""
+
+
 @click.command()
 @click.option("--seed", default=42)
 @click.option("--batch_size", default=4)
@@ -115,6 +122,20 @@ def confirm(question: str) -> bool:
                    "step completes within it, dump all-thread stacks and "
                    "the open/recent telemetry spans to stderr, then keep "
                    "running (0 = off)")
+@click.option("--stall_escalate_after", default=3,
+              help="after N consecutive stall reports for ONE stall, "
+                   "snapshot per-device memory_stats + the open-span list "
+                   "into the event stream before the surrounding timeout "
+                   "kills the run (0 = legacy single report per stall)")
+@click.option("--anomaly_factor", default=6.0,
+              help="loss-spike threshold: anomalous when loss exceeds the "
+                   "EMA baseline by this many deviations (0 = non-finite "
+                   "detection only)")
+@click.option("--anomaly_patience", default=3,
+              help="consecutive anomalous steps before rolling back to the "
+                   "last good checkpoint and skipping ahead in the data "
+                   "stream; isolated spikes are skipped (the train step's "
+                   "finite gate already refused any non-finite update)")
 def main(
     seed,
     batch_size,
@@ -155,6 +176,9 @@ def main(
     pipe_microbatches,
     pipe_schedule,
     stall_timeout,
+    stall_escalate_after,
+    anomaly_factor,
+    anomaly_patience,
 ):
     from progen_tpu.checkpoint import Package, get_checkpoint_fns
     from progen_tpu.config import ProGenConfig, load_toml_config
@@ -184,11 +208,23 @@ def main(
         train_state_shardings,
     )
 
+    from progen_tpu.resilience import chaos
+    from progen_tpu.resilience.anomaly import (
+        ROLLBACK,
+        SPIKE,
+        LossSentinel,
+        consistent_flag,
+    )
+
     if hardware_rng:
         from progen_tpu.utils.rng import use_hardware_rng
 
         use_hardware_rng()
     initialize_distributed()
+    # fault injection (PROGEN_CHAOS="ckpt/save:0.3,data/read:kill"): no-op
+    # unless the env asks for it; uninstalled in the finally below so an
+    # in-process caller (tests) never leaks rules into the next run
+    chaos.install_from_env()
 
     reset_ckpt, get_last, save_ckpt = get_checkpoint_fns(
         checkpoint_path, keep_last_n=checkpoint_keep_n,
@@ -425,14 +461,19 @@ def main(
         flops_per_tok=profiling.flops_per_token(config),
         peak=profiling.peak_flops(jax.devices()[0]),
     )
-    import math
+    import time
 
     # reference parity is ONE pass over the data (train.py:179); --epochs
     # extends the same record-index bookkeeping across passes (the data
     # iterator's skip/loop indices are global across epochs)
     num_total = num_train * max(epochs, 1)
-    seq_indices = range(start_seq_index, num_total, effective_batch)
+    # the data cursor is a VARIABLE, not a range: an anomaly rollback skips
+    # it ahead past the offending window, so the loop is a while over it
+    seq_cursor = start_seq_index
     steps_done = 0
+    rollbacks_done = 0
+    max_rollbacks = 3  # a third relapse means skipping isn't fixing it
+    sentinel = LossSentinel(factor=anomaly_factor, patience=anomaly_patience)
     profiler_active = False
     # metric step continues across resumes (state.step is checkpointed);
     # a restarted loop must not rewind the tracker's step axis
@@ -441,7 +482,11 @@ def main(
     # collective / device hang then leaves stacks + open spans in stderr
     # instead of a silent timeout kill (BASELINE.md's "dead all window")
     watchdog = (
-        StallWatchdog(stall_timeout).start() if stall_timeout > 0 else None
+        StallWatchdog(
+            stall_timeout, escalate_after=stall_escalate_after
+        ).start()
+        if stall_timeout > 0
+        else None
     )
     try:
       with mesh:
@@ -487,9 +532,9 @@ def main(
         if watchdog is not None:
             watchdog.beat()  # compile done; the step clock starts now
         # pre-fetch only when the loop will actually run: resuming a
-        # completed run (empty seq_indices) must fall through, not block
+        # completed run (cursor already past the data) must fall through, not block
         # on a skip-exhausted iterator
-        if len(seq_indices) > 0 and not (num_steps and num_steps <= 0):
+        if seq_cursor < num_total and not (num_steps and num_steps <= 0):
             batch = next_super_batch()
 
         # deferred metrics: the host logs step N-1's loss AFTER step N is
@@ -509,22 +554,56 @@ def main(
                 # host sync fence: the wait here IS the device step time
                 # (or, for the first step under lazy jit, the compile)
                 loss = float(p_metrics["last_micro_loss"])
-            if not math.isfinite(loss):
-                # failure detection (SURVEY §5): stop before a NaN spreads
-                # into the checkpoint rotation
-                raise RuntimeError(
-                    f"non-finite loss {loss} at step {p_step}; "
-                    f"last checkpoint is intact — restart resumes from it"
-                )
+            grad_norm = float(p_metrics["grad_norm"])
+            skipped = int(p_metrics.get("skipped", 0))
+            # chaos perturbation point: PROGEN_CHAOS="train/loss:spike@2"
+            # feeds the sentinel a poisoned value without touching the
+            # device state — the rollback path rehearsed in-process
+            loss = chaos.perturb("train/loss", loss)
+            # failure TOLERANCE, not just detection (SURVEY §5): the
+            # step's finite gate already refused a non-finite update, so
+            # an isolated anomaly is skipped; ``patience`` consecutive
+            # ones escalate to checkpoint rollback + data skip-ahead.
+            # The verdict must bind every host (one host rolling back
+            # alone deadlocks the next collective) — allgather-max, the
+            # same pattern as the stop flag below.
+            verdict = sentinel.observe(loss, grad_norm)
+            if consistent_flag(verdict == ROLLBACK):
+                raise AnomalyRollback(p_step, loss)
+            if verdict == SPIKE or skipped:
+                if is_coordinator():
+                    step_print(
+                        p_step,
+                        f"anomaly: loss {loss:.4g} grad_norm "
+                        f"{grad_norm:.4g}"
+                        + (" (update refused on-device)" if skipped else "")
+                        + f"; {sentinel.consecutive}/{sentinel.patience} "
+                        "consecutive before rollback",
+                    )
+                telemetry.get_telemetry().emit({
+                    "ev": "anomaly", "ts": time.time(), "step": p_step,
+                    "loss": loss, "grad_norm": grad_norm,
+                    "skipped": skipped,
+                    "consecutive": sentinel.consecutive,
+                })
             perf = timer.tick(effective_batch * config.seq_len)
             with ledger.track("log"):
                 if is_coordinator():
                     step_print(p_step, f"loss: {loss:.4f}")
                 tracker.log(
-                    {"loss": loss, **(perf or {}), **hbm_gauges()},
+                    {"loss": loss, "grad_norm": grad_norm,
+                     **({"anomaly": 1} if verdict != "ok" else {}),
+                     **(perf or {}), **hbm_gauges()},
                     step=p_step,
                 )
-        for i, seq_index in enumerate(tqdm.tqdm(seq_indices, mininterval=10)):
+        pbar = tqdm.tqdm(
+            total=num_total, initial=min(seq_cursor, num_total),
+            mininterval=10, unit="seq",
+        )
+        i = 0
+        while seq_cursor < num_total:
+          try:
+            seq_index = seq_cursor
             stop = stop_requested["flag"]
             if jax.process_count() > 1:
                 # every host must agree before leaving the collective loop
@@ -646,9 +725,81 @@ def main(
                     render_sample_html(prime_str, sampled_str),
                     step=global_step,
                 )
+            seq_cursor = next_seq_index
+            i += 1
+            pbar.update(effective_batch)
+          except AnomalyRollback as exc:
+            rollbacks_done += 1
+            pending = None  # the queued step's metrics are the anomaly
+            step_at, bad_loss = exc.args
+            if rollbacks_done > max_rollbacks:
+                raise RuntimeError(
+                    f"{rollbacks_done} anomaly rollbacks without recovery "
+                    f"(last loss {bad_loss} at step {step_at}); skipping "
+                    "data is not fixing this — inspect the stream/hparams"
+                ) from exc
+            with telemetry.span("train/rollback", step=step_at), \
+                    ledger.track("checkpoint") as tr:
+                from progen_tpu.checkpoint import sharded_abstract_state
+
+                # publish any in-flight async save so the restore walk
+                # sees the newest COMPLETE checkpoint
+                save_ckpt.flush()
+                _, abstract = abstract_train_state(
+                    model, optimizer, config.seq_len
+                )
+                pkg = get_last(sharded_abstract_state(abstract, shardings))
+                if pkg is None:
+                    raise RuntimeError(
+                        f"anomaly rollback requested at step {step_at} "
+                        "but no checkpoint exists to roll back to"
+                    ) from exc
+                state = pkg.state
+                # skip ahead PAST the offending window: the stream
+                # resumes one effective batch beyond where the anomaly
+                # surfaced, not where the checkpoint left off —
+                # re-feeding the same records would just spike again
+                seq_cursor = seq_cursor + effective_batch
+                train_ds.close()
+                train_ds = train_iter_fn(
+                    config.seq_len,
+                    batch_size,
+                    skip=seq_cursor,
+                    loop=True,
+                    shuffle_seed=shuffle_seed,
+                    **proc_kwargs,
+                )
+                sentinel.reset()
+                if seq_cursor < num_total:
+                    batch = next_super_batch()
+            timer.exclude(tr.seconds)
+            restored_step = int(jax.device_get(state.step))
+            if is_coordinator():
+                step_print(
+                    step_at,
+                    f"anomaly rollback {rollbacks_done}/{max_rollbacks}: "
+                    f"restored checkpoint (state step {restored_step}), "
+                    f"data skipped ahead to sequence {seq_cursor}",
+                )
+            telemetry.get_telemetry().emit({
+                "ev": "anomaly_rollback", "ts": time.time(),
+                "step": step_at, "loss": bad_loss,
+                "restored_step": restored_step,
+                "next_seq_index": seq_cursor,
+                "rollbacks_done": rollbacks_done,
+            })
+            pbar.update(effective_batch)
+            if watchdog is not None:
+                watchdog.beat()
+        pbar.close()
         # stop-flag / exhausted-iterator exits leave the last step queued:
-        # its loss (and the non-finite gate) must land before the final save
-        flush_metrics()
+        # its loss (and the sentinel verdict) must land before the final
+        # save; a rollback verdict HERE just ends the run — the state
+        # below passed the finite gate, so saving it is safe
+        try:
+            flush_metrics()
+        except AnomalyRollback:
+            pass
         # goodput closes the books on the loop: MFU said how fast the
         # steps were, this says how often the loop was actually stepping
         report = ledger.report()
@@ -664,6 +815,7 @@ def main(
     finally:
         # nested so each cleanup runs even if an earlier one raises
         try:
+            chaos.uninstall()  # rules must not leak into a later in-process run
             if watchdog is not None:
                 watchdog.stop()
             # detach the span sink BEFORE the tracker closes its files:
@@ -691,10 +843,11 @@ def main(
                     valid_ds.close()
 
     # final checkpoint so short runs (e.g. --num_steps) always persist;
-    # next_seq_index counts exactly the records consumed by executed steps
+    # the cursor counts exactly the records consumed by executed steps
+    # PLUS any rollback skip-ahead — resume must not re-read either
     save_ckpt(
         Package(
-            next_seq_index=start_seq_index + steps_done * effective_batch,
+            next_seq_index=seq_cursor,
             state=state,
             model_config=config.to_dict(),
             run_id=run_id,

@@ -153,7 +153,13 @@ def iterator_from_tfrecords_folder(
 ) -> Tuple[int, Callable]:
     """Returns (total_num_seqs, iter_fn) — interface parity with data.py:37."""
     if folder.startswith("gs://"):
-        filenames = _gcs_glob(folder, data_type)
+        # the listing is the run's first network IO; a transient GCS blip
+        # here used to kill the job before a single step ran
+        from progen_tpu.resilience.retry import retry_call
+
+        filenames = retry_call(
+            _gcs_glob, folder, data_type, label="data/glob"
+        )
     else:
         filenames = _local_glob(folder, data_type)
     filenames = sorted(filenames, key=_sort_key)

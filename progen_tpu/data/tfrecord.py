@@ -213,19 +213,29 @@ def tfrecord_writer(path: str, key: str = "seq"):
         yield write
 
 
+def _read_file_bytes(path: str) -> bytes:
+    with gzip.open(path, "rb") as fp:
+        return fp.read()
+
+
 def read_tfrecords(path: str, key: str = "seq") -> Iterator[bytes]:
     """Yield the ``key`` feature of every Example in a gzip TFRecord file.
 
     Fast path: decompress the whole file and batch-parse framing + proto in
     the native C++ engine (one ctypes call for all records); falls back to
-    the pure-Python streaming codec."""
-    from progen_tpu.data import _native
+    the pure-Python codec. Either way the whole-file read happens up front
+    under the resilience retry policy (label ``data/read``): a transient
+    network-filesystem hiccup is re-tried with backoff instead of killing
+    the input pipeline mid-epoch, and a retry restarts from byte 0 so no
+    record is ever yielded twice."""
+    import io
 
+    from progen_tpu.data import _native
+    from progen_tpu.resilience.retry import retry_call
+
+    data = retry_call(_read_file_bytes, path, label="data/read")
     if _native.load() is not None:
-        with gzip.open(path, "rb") as fp:
-            data = fp.read()
         yield from _native.parse_file(data, key.encode())
         return
-    with gzip.open(path, "rb") as fp:
-        for payload in read_records(fp):
-            yield decode_example(payload, key)
+    for payload in read_records(io.BytesIO(data)):
+        yield decode_example(payload, key)

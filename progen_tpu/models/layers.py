@@ -146,6 +146,7 @@ class LocalAttentionBlock(nn.Module):
                 )
             elif c.use_pallas_attn:
                 from progen_tpu.ops.pallas_attention import (
+                    PALLAS_API_OK,
                     measured_impls,
                     pallas_local_attention,
                 )
@@ -165,7 +166,11 @@ class LocalAttentionBlock(nn.Module):
                 fwd_impl, bwd_impl, g = measured_impls(w, n=n, bh=b * h)
                 if c.pallas_bh_block:
                     g = c.pallas_bh_block  # explicit config beats policy
-                if fwd_impl == "xla" and bwd_impl == "xla":
+                if not PALLAS_API_OK:
+                    # installed jax predates the kernel API family: the
+                    # XLA golden (same math) keeps the config runnable
+                    out = local_attention(q, k, v, window_size=w)
+                elif fwd_impl == "xla" and bwd_impl == "xla":
                     # both directions lost on-chip at this shape: plain
                     # XLA autodiff (going through the custom VJP would
                     # recompute the forward inside the backward for

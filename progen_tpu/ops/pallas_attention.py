@@ -282,8 +282,23 @@ def _specs(w: int, d: int):
 
 # every kernel here writes disjoint output blocks per grid step (the halo
 # backward's overlap is resolved OUTSIDE the kernel), so Mosaic may reorder
-# and pipeline both grid dimensions freely
-_PARALLEL_GRID = pltpu.CompilerParams(
+# and pipeline both grid dimensions freely.
+# jax <0.7 spells CompilerParams as TPUCompilerParams — accept both so the
+# module imports (and the XLA fallback paths run) across the version range
+_CompilerParams = getattr(
+    pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+)
+
+# Can the kernels in this module actually trace under the installed jax?
+# They lean on the 0.7-era API family — ``jax.typeof`` (vma plumbed into
+# out_shapes), the vma kwarg on ShapeDtypeStruct, CompilerParams (aliased
+# above). ``jax.typeof`` is the discriminating probe: absent it, calling
+# any kernel raises AttributeError mid-trace. Model code (models/layers.py,
+# parallel/ring_attention.py) consults this flag and falls back to the XLA
+# golden path instead, so a config shipping use_pallas_attn=true stays
+# runnable on an older runtime; kernel tests skip on it.
+PALLAS_API_OK = hasattr(jax, "typeof") and _CompilerParams is not None
+_PARALLEL_GRID = _CompilerParams(
     dimension_semantics=("parallel", "parallel")
 )
 

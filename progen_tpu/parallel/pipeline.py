@@ -122,6 +122,10 @@ def pipeline_apply(
         mesh=mesh,
         in_specs=(P(axis), P(None, data_axis) if dp else P()),
         out_specs=P(axis, None, data_axis) if dp else P(axis),
+        # without lax.pcast (jax < 0.7) the scan carry can't be typed as
+        # stage-varying, so the replication checker false-positives on
+        # the scan-of-ppermute; its own error prescribes disabling it
+        check_vma=hasattr(jax.lax, "pcast"),
     )(stacked_params, x_mb)
     # outs: (P, T, mb, ...); finished microbatches live on the last stage
     final = outs[n_stages - 1, n_stages - 1 : n_stages - 1 + M]

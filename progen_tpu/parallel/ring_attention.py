@@ -81,6 +81,7 @@ def ring_local_attention(
         halo_v = jnp.where(is_first, zero, halo_v)
         if use_pallas:
             from progen_tpu.ops.pallas_attention import (
+                PALLAS_API_OK,
                 measured_impls,
                 pallas_local_attention_halo,
             )
@@ -92,7 +93,12 @@ def ring_local_attention(
             fwd_impl, bwd_impl, g = measured_impls(
                 w, n=n_l, bh=b_l * h_l
             )
-            if not (fwd_impl == "xla" and bwd_impl == "xla"):
+            # installed jax may predate the kernel API family — the XLA
+            # halo path below computes the same math, so requesting
+            # pallas stays runnable instead of failing at trace time
+            if PALLAS_API_OK and not (
+                fwd_impl == "xla" and bwd_impl == "xla"
+            ):
                 return pallas_local_attention_halo(
                     q, k, v, halo_k, halo_v, w, scale, interpret,
                     bwd_impl, g, fwd_impl,

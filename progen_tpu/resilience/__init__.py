@@ -1,0 +1,57 @@
+"""Fault-tolerance layer: retry/backoff, chaos injection, anomaly
+sentinel.
+
+Production-scale training and serving survive three failure families
+this package owns end to end (wired through checkpoint.py, the data
+path, cli/train.py, and cli/serve.py):
+
+  * transient IO faults   -> retry.py (classified exponential backoff);
+  * process death / data
+    corruption            -> checkpoint integrity manifest + fallback
+                             chain (checkpoint.py) rehearsed by chaos.py;
+  * numerical anomalies   -> anomaly.py (skip isolated spikes, roll
+                             back to the last good checkpoint and skip
+                             ahead in the data on persistent ones).
+"""
+
+from progen_tpu.resilience.anomaly import (
+    OK,
+    ROLLBACK,
+    SPIKE,
+    LossSentinel,
+    consistent_flag,
+)
+from progen_tpu.resilience.chaos import (
+    ChaosError,
+    ChaosInjector,
+    install_from_env,
+    maybe_inject,
+    perturb,
+)
+from progen_tpu.resilience.retry import (
+    RetryPolicy,
+    TransientError,
+    is_transient,
+    policy_from_env,
+    retry_call,
+    retryable,
+)
+
+__all__ = [
+    "OK",
+    "SPIKE",
+    "ROLLBACK",
+    "LossSentinel",
+    "consistent_flag",
+    "ChaosError",
+    "ChaosInjector",
+    "install_from_env",
+    "maybe_inject",
+    "perturb",
+    "RetryPolicy",
+    "TransientError",
+    "is_transient",
+    "policy_from_env",
+    "retry_call",
+    "retryable",
+]

@@ -1,0 +1,111 @@
+"""Loss/grad-norm spike sentinel with skip-then-rollback escalation.
+
+cli/train.py used to raise on the first non-finite loss — correct
+failure *detection*, but recovery was "a human restarts it". The
+sentinel implements the staged response production runs actually want
+(MegaScale §5, PAPERS.md):
+
+  1. an ISOLATED anomaly (loss spike, non-finite loss/grad-norm) is
+     *skipped*: the train step's device-side finite gate already
+     refused the poisoned update (training/step.py), so the loop just
+     logs the event and keeps going;
+  2. N CONSECUTIVE anomalies mean the stream or the state is bad in a
+     way skipping won't fix: the sentinel escalates to ``rollback`` —
+     the loop restores the last good checkpoint and skips ahead in the
+     data stream past the offending window.
+
+Statistics: Welford-style EMA of loss with an EMA of absolute deviation
+(robust to the very spikes being detected — a spiky sample never enters
+the baseline). A sample is anomalous when non-finite or above
+``ema + factor * deviation`` after ``warmup`` clean observations.
+
+Multi-host: decisions must be collective (one host rolling back alone
+deadlocks the next all-reduce). ``consistent_flag`` applies the same
+allgather-max pattern as the train loop's stop flag: ANY host's verdict
+binds all hosts.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+OK = "ok"
+SPIKE = "spike"
+ROLLBACK = "rollback"
+
+
+class LossSentinel:
+    def __init__(
+        self,
+        factor: float = 6.0,
+        patience: int = 3,
+        warmup: int = 10,
+        beta: float = 0.95,
+        min_dev: float = 0.05,
+    ):
+        if patience < 1:
+            raise ValueError(f"patience must be >= 1, got {patience}")
+        self.factor = float(factor)
+        self.patience = int(patience)
+        self.warmup = int(warmup)
+        self.beta = float(beta)
+        self.min_dev = float(min_dev)
+        self.reset()
+
+    def reset(self) -> None:
+        """Forget everything — called after a rollback (the restored
+        state's loss scale may differ from the poisoned tail's)."""
+        self.mean: Optional[float] = None
+        self.dev = 0.0
+        self.n_clean = 0
+        self.consecutive = 0
+        self.n_anomalies = 0
+
+    # ----- classification -------------------------------------------------
+
+    def _is_anomalous(self, loss: float, grad_norm: Optional[float]) -> bool:
+        if not math.isfinite(loss):
+            return True
+        if grad_norm is not None and not math.isfinite(grad_norm):
+            return True
+        if self.factor <= 0 or self.n_clean < self.warmup:
+            return False
+        assert self.mean is not None
+        return loss > self.mean + self.factor * max(self.dev, self.min_dev)
+
+    def observe(
+        self, loss: float, grad_norm: Optional[float] = None
+    ) -> str:
+        """Feed one step's (loss, grad_norm); returns OK, SPIKE (skip
+        and continue), or ROLLBACK (``consecutive >= patience``).
+        Anomalous samples never update the baseline."""
+        if self._is_anomalous(loss, grad_norm):
+            self.consecutive += 1
+            self.n_anomalies += 1
+            return ROLLBACK if self.consecutive >= self.patience else SPIKE
+        self.consecutive = 0
+        if self.mean is None:
+            self.mean = loss
+        else:
+            self.dev = (
+                self.beta * self.dev + (1 - self.beta) * abs(loss - self.mean)
+            )
+            self.mean = self.beta * self.mean + (1 - self.beta) * loss
+        self.n_clean += 1
+        return OK
+
+
+def consistent_flag(flag: bool) -> bool:
+    """Multihost-consistent boolean: allgather-max over processes (the
+    stop-flag pattern, cli/train.py). Single-process: identity."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return bool(flag)
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    return bool(
+        multihost_utils.process_allgather(np.int32(bool(flag))).max()
+    )

@@ -1,0 +1,168 @@
+"""Env-driven fault injection keyed on telemetry span names.
+
+Recovery code that only runs during real incidents is recovery code
+that has never run. This module turns the existing telemetry span
+vocabulary (``ckpt/save``, ``ckpt/restore``, ``data/read``,
+``train/eval``, ...) into injection points, so a test — or a brave
+operator — can rehearse every failure mode the resilience layer claims
+to survive:
+
+    PROGEN_CHAOS="ckpt/save:0.3,data/read:kill"
+
+Comma-separated ``target:spec`` rules; ``target`` is a span name or a
+retry-site label (resilience/retry.py labels its attempts). Specs:
+
+  * ``0.3``      — raise a transient ``ChaosError`` with probability
+                   0.3 at each hit (seeded by ``PROGEN_CHAOS_SEED``);
+  * ``fail@N``   — raise deterministically on the Nth hit (1-based);
+  * ``kill``     — SIGKILL the process at the first hit;
+  * ``kill@N``   — SIGKILL at the Nth hit (the kill-matrix harness
+                   walks N across a run's span timeline);
+  * ``spike@N``  — value perturbation: the first N calls to
+                   ``perturb(target, x)`` return a huge loss (1e9).
+                   Used by the anomaly-sentinel integration tests via
+                   the ``train/loss`` site in cli/train.py;
+  * ``nan@N``    — like ``spike@N`` but returns NaN.
+
+Injection is wired in two places so no production code needs test-only
+seams: the telemetry span entry hook (installed by ``install_from_env``)
+and the per-attempt hook inside ``retry_call``. With ``PROGEN_CHAOS``
+unset everything here is a dict-lookup no-op.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from progen_tpu.resilience.retry import TransientError
+
+
+class ChaosError(TransientError):
+    """Injected transient fault (classified retryable by design)."""
+
+
+@dataclass
+class _Rule:
+    kind: str  # "prob" | "fail" | "kill" | "spike" | "nan"
+    arg: float  # probability, or hit index / count
+    hits: int = 0
+
+
+def _parse(spec: str) -> Dict[str, _Rule]:
+    rules: Dict[str, _Rule] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        target, _, s = part.rpartition(":")
+        if not target:
+            raise ValueError(f"chaos rule needs 'target:spec': {part!r}")
+        if s == "kill":
+            rules[target] = _Rule("kill", 1)
+        elif s.startswith("kill@"):
+            rules[target] = _Rule("kill", int(s[len("kill@"):]))
+        elif s.startswith("fail@"):
+            rules[target] = _Rule("fail", int(s[len("fail@"):]))
+        elif s.startswith("spike@"):
+            rules[target] = _Rule("spike", int(s[len("spike@"):]))
+        elif s.startswith("nan@"):
+            rules[target] = _Rule("nan", int(s[len("nan@"):]))
+        else:
+            p = float(s)
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(f"chaos probability out of [0,1]: {part!r}")
+            rules[target] = _Rule("prob", p)
+    return rules
+
+
+class ChaosInjector:
+    def __init__(self, spec: str, seed: int = 0):
+        self.rules = _parse(spec)
+        self._rng = random.Random(seed)
+
+    def on_site(self, name: str) -> None:
+        """Called at a span entry / retry attempt named ``name``."""
+        rule = self.rules.get(name)
+        if rule is None or rule.kind in ("spike", "nan"):
+            return
+        rule.hits += 1
+        if rule.kind == "prob":
+            if self._rng.random() < rule.arg:
+                raise ChaosError(f"chaos: injected fault at {name!r}")
+        elif rule.kind == "fail":
+            if rule.hits == rule.arg:
+                raise ChaosError(
+                    f"chaos: injected fault at {name!r} (hit {rule.hits})"
+                )
+        elif rule.kind == "kill":
+            if rule.hits == rule.arg:
+                # flush whatever the process has buffered — the whole
+                # point is to die where a preemption would
+                import sys
+
+                for f in (sys.stdout, sys.stderr):
+                    try:
+                        f.flush()
+                    except (OSError, ValueError):
+                        pass
+                os.kill(os.getpid(), signal.SIGKILL)
+
+    def perturb(self, name: str, value: float) -> float:
+        """Value-level injection (``spike@N`` / ``nan@N`` rules)."""
+        rule = self.rules.get(name)
+        if rule is None or rule.kind not in ("spike", "nan"):
+            return value
+        if rule.hits >= rule.arg:
+            return value
+        rule.hits += 1
+        return float("nan") if rule.kind == "nan" else 1e9
+
+
+_INJECTOR: Optional[ChaosInjector] = None
+
+
+def install(spec: str, seed: int = 0) -> ChaosInjector:
+    """Install an injector and hook it into telemetry span entry."""
+    global _INJECTOR
+    _INJECTOR = ChaosInjector(spec, seed)
+    from progen_tpu.telemetry import spans
+
+    if maybe_inject not in spans.SPAN_ENTRY_HOOKS:
+        spans.SPAN_ENTRY_HOOKS.append(maybe_inject)
+    return _INJECTOR
+
+
+def uninstall() -> None:
+    global _INJECTOR
+    _INJECTOR = None
+    from progen_tpu.telemetry import spans
+
+    if maybe_inject in spans.SPAN_ENTRY_HOOKS:
+        spans.SPAN_ENTRY_HOOKS.remove(maybe_inject)
+
+
+def install_from_env() -> Optional[ChaosInjector]:
+    """Install from ``PROGEN_CHAOS`` (uninstall when unset/empty) —
+    called at CLI entry points so a subprocess under test inherits its
+    fault plan from the environment alone."""
+    spec = os.environ.get("PROGEN_CHAOS", "").strip()
+    if not spec:
+        uninstall()
+        return None
+    return install(spec, seed=int(os.environ.get("PROGEN_CHAOS_SEED", "0")))
+
+
+def maybe_inject(name: str) -> None:
+    """The hook: no-op unless an injector is installed."""
+    if _INJECTOR is not None:
+        _INJECTOR.on_site(name)
+
+
+def perturb(name: str, value: float) -> float:
+    if _INJECTOR is None:
+        return value
+    return _INJECTOR.perturb(name, value)

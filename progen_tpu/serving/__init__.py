@@ -13,6 +13,8 @@ engine.py for the design. Typical use:
 from progen_tpu.serving.engine import ServeEngine, SlotBatch
 from progen_tpu.serving.metrics import ServingMetrics
 from progen_tpu.serving.scheduler import (
+    REJECT_DEADLINE,
+    REJECT_DRAINING,
     REJECT_QUEUE_FULL,
     Completion,
     Request,
@@ -29,4 +31,6 @@ __all__ = [
     "TokenEvent",
     "Completion",
     "REJECT_QUEUE_FULL",
+    "REJECT_DEADLINE",
+    "REJECT_DRAINING",
 ]

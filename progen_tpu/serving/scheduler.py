@@ -26,13 +26,21 @@ from progen_tpu.serving.engine import ServeEngine
 from progen_tpu.serving.metrics import ServingMetrics
 
 REJECT_QUEUE_FULL = "queue_full"
+REJECT_DEADLINE = "deadline_exceeded"
+REJECT_DRAINING = "draining"
 
 
 @dataclasses.dataclass
 class Request:
     """One generation request. ``seed`` derives the PRNG key unless an
     explicit ``key`` is given; either way the response is bit-identical
-    to ``sample_fast`` with that key on this prime."""
+    to ``sample_fast`` with that key on this prime.
+
+    ``deadline_s`` is a queue TTL relative to submit time: a request
+    still waiting for a slot past it is expired (reject reason
+    ``deadline_exceeded``) instead of admitted — serving a response the
+    client has already timed out on just wastes decode steps. Requests
+    already on a slot are never expired mid-decode."""
 
     id: str
     prime: object  # 1-D int token ids
@@ -43,6 +51,7 @@ class Request:
     top_p: Optional[float] = None
     seed: int = 0
     key: object = None
+    deadline_s: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -92,6 +101,9 @@ class Scheduler:
         self._clock = clock
         self._queue: deque[Tuple[Request, float]] = deque()
         self._active: dict[int, _Active] = {}
+        # queued requests expired/shed since the last ``pop_expired()``:
+        # (request, reason) — the front-end owns client notification
+        self._expired: List[Tuple[Request, str]] = []
 
     # ----- intake ---------------------------------------------------------
 
@@ -110,6 +122,10 @@ class Scheduler:
             self.metrics.inc("requests_rejected")
             self.metrics.inc("rejected_invalid")
             return False, f"invalid: {e}"
+        if req.deadline_s is not None and req.deadline_s <= 0:
+            self.metrics.inc("requests_rejected")
+            self.metrics.inc("rejected_invalid")
+            return False, f"invalid: deadline_s must be > 0, got {req.deadline_s}"
         if len(self._queue) >= self.max_queue:
             self.metrics.inc("requests_rejected")
             self.metrics.inc("rejected_queue_full")
@@ -131,6 +147,48 @@ class Scheduler:
     @property
     def active_ids(self) -> List[str]:
         return [a.req.id for a in self._active.values()]
+
+    def _expire_queued(self, now: float) -> None:
+        """Shed queued requests whose deadline passed BEFORE admission —
+        after a stall or a burst, the head of the queue can be entirely
+        dead air, and admitting it would spend prefill+decode on clients
+        that already hung up."""
+        if not any(req.deadline_s is not None for req, _ in self._queue):
+            return
+        kept: deque[Tuple[Request, float]] = deque()
+        for req, t_submit in self._queue:
+            if (
+                req.deadline_s is not None
+                and now - t_submit >= req.deadline_s
+            ):
+                self.metrics.inc("requests_expired")
+                self.metrics.inc("requests_rejected")
+                self.metrics.inc("rejected_deadline_exceeded")
+                self._expired.append((req, REJECT_DEADLINE))
+            else:
+                kept.append((req, t_submit))
+        self._queue = kept
+        self.metrics.set_gauge("queue_depth", len(self._queue))
+
+    def pop_expired(self) -> List[Tuple[Request, str]]:
+        """(request, reason) pairs shed from the queue since the last
+        call — expired deadlines and drains; the caller notifies the
+        owners."""
+        out, self._expired = self._expired, []
+        return out
+
+    def drain_queue(self, reason: str = REJECT_DRAINING) -> int:
+        """Graceful-shutdown intake cut: reject every QUEUED request
+        (surfaced via ``pop_expired``) while in-flight slots keep
+        decoding. Returns how many were shed."""
+        n = len(self._queue)
+        while self._queue:
+            req, _ = self._queue.popleft()
+            self.metrics.inc("requests_rejected")
+            self.metrics.inc(f"rejected_{reason}")
+            self._expired.append((req, reason))
+        self.metrics.set_gauge("queue_depth", 0)
+        return n
 
     def _admit(self) -> None:
         while self._queue:
@@ -156,7 +214,10 @@ class Scheduler:
     def step(self) -> Tuple[List[TokenEvent], List[Completion]]:
         """Admit what fits, then advance every live slot one token.
         Returns the tokens produced this step (streaming order =
-        slot order, stable) and any requests that finished."""
+        slot order, stable) and any requests that finished. Expired
+        queued requests are shed first (check ``pop_expired()``) so a
+        dead deadline never consumes a freed slot."""
+        self._expire_queued(self._clock())
         self._admit()
         if not self._active:
             return [], []

@@ -57,6 +57,13 @@ class EventLog:
                 self._f.close()
 
 
+# span-entry hooks: called with the span name after the B record is
+# emitted, INSIDE the span's try block — a hook that raises surfaces to
+# the span's caller while the E record still closes the span. The chaos
+# injector (resilience/chaos.py) registers here; empty list = no-op.
+SPAN_ENTRY_HOOKS: list = []
+
+
 def _named_scope(name: str):
     try:
         import jax
@@ -106,6 +113,8 @@ class Telemetry:
         self.emit(begin)
         t0 = time.perf_counter()
         try:
+            for hook in SPAN_ENTRY_HOOKS:
+                hook(name)
             with _named_scope(name):
                 yield
         finally:

@@ -22,6 +22,14 @@ It re-arms if beats resume (a transient stall logs one report and the
 run continues). The thread never kills the process — the surrounding
 timeout machinery (driver, bench phase kill) owns that decision; the
 watchdog's job is to make sure the kill leaves evidence.
+
+Escalation (``escalate_after=N``): instead of reporting once per
+stall, the watchdog re-reports every further ``deadline_s`` the stall
+persists, and on the Nth consecutive report for the SAME stall it
+snapshots ``device.memory_stats()`` for every visible device plus the
+open-span list into the telemetry sink (events.jsonl) and the report
+stream — the full forensic record, captured BEFORE the surrounding
+timeout kills the run (ROADMAP "watchdog escalation hook").
 """
 
 from __future__ import annotations
@@ -45,6 +53,8 @@ class StallWatchdog:
         on_stall: Optional[Callable[[dict], None]] = None,
         poll_s: Optional[float] = None,
         clock: Callable[[], float] = time.monotonic,
+        escalate_after: int = 0,
+        memory_stats_fn: Optional[Callable[[], list]] = None,
     ):
         if deadline_s <= 0:
             raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
@@ -58,7 +68,11 @@ class StallWatchdog:
         self._clock = clock
         self._last_beat = clock()
         self._fired_for_beat: Optional[float] = None
+        self._fires_this_stall = 0
         self.fire_count = 0
+        self.escalate_after = int(escalate_after)
+        self.escalation_count = 0
+        self._memory_stats_fn = memory_stats_fn
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -101,14 +115,32 @@ class StallWatchdog:
             stalled_s = self._clock() - last
             if stalled_s < self.deadline_s:
                 continue
-            if self._fired_for_beat == last:
-                continue  # already reported THIS stall; re-arm on beat
-            self._fired_for_beat = last
+            if self._fired_for_beat != last:
+                # a NEW stall (beats resumed since the last report)
+                self._fired_for_beat = last
+                self._fires_this_stall = 0
+            if self.escalate_after > 0:
+                # periodic re-report: the (n+1)-th fires once the stall
+                # has lasted (n+1) deadlines
+                if stalled_s < self.deadline_s * (self._fires_this_stall + 1):
+                    continue
+            elif self._fires_this_stall:
+                continue  # legacy: once per stall; re-arm on beat
+            self._fires_this_stall += 1
             self.fire_count += 1
             try:
                 self._fire(stalled_s)
             except Exception:
                 pass  # a broken reporter must not crash the daemon
+            if (
+                self.escalate_after > 0
+                and self._fires_this_stall == self.escalate_after
+            ):
+                self.escalation_count += 1
+                try:
+                    self._escalate(stalled_s)
+                except Exception:
+                    pass
 
     def _fire(self, stalled_s: float) -> None:
         out = self._file if self._file is not None else sys.stderr
@@ -157,3 +189,61 @@ class StallWatchdog:
         tel.emit(report)
         if self._on_stall is not None:
             self._on_stall(report)
+
+    def _escalate(self, stalled_s: float) -> None:
+        """Nth consecutive report for one stall: snapshot per-device
+        allocator state + the open spans into the telemetry sink, so the
+        record survives the kill that usually follows."""
+        out = self._file if self._file is not None else sys.stderr
+        tel = (
+            self._telemetry
+            if self._telemetry is not None
+            else get_telemetry()
+        )
+        mem = (
+            self._memory_stats_fn
+            if self._memory_stats_fn is not None
+            else _device_memory_stats
+        )()
+        record = {
+            "ev": "stall_escalation",
+            "ts": time.time(),
+            "stalled_s": round(stalled_s, 3),
+            "consecutive_reports": self._fires_this_stall,
+            "memory_stats": mem,
+            "open_spans": [
+                {"span": r["span"], "ts": r["ts"]}
+                for r in tel.open_spans()
+            ],
+        }
+        print(
+            f"[stall-watchdog] ESCALATION after "
+            f"{self._fires_this_stall} consecutive stall reports "
+            f"({stalled_s:.1f}s): device memory + open spans snapshotted "
+            "to the event stream",
+            file=out,
+            flush=True,
+        )
+        tel.emit(record)
+
+
+def _device_memory_stats() -> list:
+    """Per-device ``memory_stats()`` snapshot; [] when jax/backend
+    offers none (CPU) — the escalation record is still useful for its
+    open-span list."""
+    try:
+        import jax
+
+        devices = jax.devices()
+    except Exception:
+        return []
+    out = []
+    for d in devices:
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:
+            stats = {}
+        out.append({"device": str(d.id), **{
+            k: v for k, v in stats.items() if isinstance(v, (int, float))
+        }})
+    return out

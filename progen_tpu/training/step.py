@@ -89,13 +89,29 @@ def make_train_step(
                     grads, state.opt_state, state.params
                 )
                 params = optax.apply_updates(state.params, updates)
+
+            # finite gate: the state is DONATED, so a poisoned update can
+            # never be undone host-side — refuse it on-device instead.
+            # When any micro-loss or the grad norm is non-finite the step
+            # re-emits the incoming state (step counter included), and the
+            # anomaly sentinel (resilience/anomaly.py) sees the bad
+            # metrics and decides skip vs rollback.
+            grad_norm = optax.global_norm(grads)
+            with jax.named_scope("finite_gate"):
+                ok = jnp.isfinite(losses).all() & jnp.isfinite(grad_norm)
+                gate = lambda new, old: jnp.where(ok, new, old)  # noqa: E731
+                params = jax.tree.map(gate, params, state.params)
+                opt_state = jax.tree.map(gate, opt_state, state.opt_state)
+            # step still advances on a refusal — the batch was consumed,
+            # and the data cursor must agree with the step count on resume
             new_state = state.replace(
                 step=state.step + 1, params=params, opt_state=opt_state
             )
             metrics = {
                 "loss": losses.mean(),
                 "last_micro_loss": losses[-1],
-                "grad_norm": optax.global_norm(grads),
+                "grad_norm": grad_norm,
+                "skipped": (~ok).astype(jnp.int32),
             }
             return new_state, metrics
 

@@ -67,6 +67,12 @@ def test_two_process_training_matches_single(tmp_path):
         for p in procs:  # never leak workers (they hold the port + CPU)
             if p.poll() is None:
                 p.kill()
+    # capability gate, not an error gate: some jax builds ship a CPU
+    # backend without cross-process (Gloo) collectives at all — the
+    # workers then die with this exact message before any assertion this
+    # test makes is reachable. Anything else still fails below.
+    if any("aren't implemented on the CPU backend" in o for o in outs):
+        pytest.skip("this jax build lacks multi-process CPU collectives")
     for i, out in enumerate(outs):
         assert "WORKER_OK" in out, f"proc {i} failed:\n{out[-2000:]}"
 

@@ -1,0 +1,477 @@
+"""Fault-tolerance layer: retry classification/backoff, chaos injection,
+anomaly sentinel, watchdog escalation, and the chaos-driven train-loop
+integration (spike skip, rollback + data skip-ahead, retried IO faults).
+
+Kill-style chaos is deliberately absent here — a SIGKILL rule would take
+pytest down with it. Process-death coverage lives in the subprocess
+kill matrix (test_chaos_matrix.py).
+"""
+
+import io
+import json
+import random
+import time
+
+import numpy as np
+import pytest
+
+from progen_tpu.resilience import anomaly, chaos, retry
+
+# ------------------------------------------------------------------ retry
+
+
+class TestClassification:
+    def test_fatal_types_never_retried(self):
+        for exc in (
+            ValueError("x"), TypeError("x"), KeyError("x"),
+            FileNotFoundError("x"), PermissionError("x"),
+            IsADirectoryError("x"), AssertionError("x"),
+        ):
+            assert not retry.is_transient(exc), type(exc).__name__
+
+    def test_transient_types_retried(self):
+        import errno
+
+        for exc in (
+            ConnectionResetError("x"), TimeoutError("x"),
+            InterruptedError("x"), retry.TransientError("x"),
+            chaos.ChaosError("x"), OSError(errno.EIO, "io"),
+            OSError("errno-less storage weather"),
+        ):
+            assert retry.is_transient(exc), type(exc).__name__
+
+    def test_cloud_api_errors_matched_by_name(self):
+        # duck-typed: google.api_core etc. never become imports
+        ServiceUnavailable = type("ServiceUnavailable", (Exception,), {})
+        DeadlineExceeded = type("DeadlineExceeded", (Exception,), {})
+        Boring = type("SomeOtherError", (Exception,), {})
+        assert retry.is_transient(ServiceUnavailable())
+        assert retry.is_transient(DeadlineExceeded())
+        assert not retry.is_transient(Boring())
+
+    def test_explicit_transient_attribute_wins(self):
+        e = ValueError("marked")
+        e.transient = True
+        assert retry.is_transient(e)
+        e2 = ConnectionResetError("unmarked")
+        e2.transient = False
+        assert not retry.is_transient(e2)
+
+
+class TestRetryCall:
+    def test_succeeds_after_transient_failures(self):
+        sleeps = []
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise ConnectionResetError("blip")
+            return "ok"
+
+        out = retry.retry_call(
+            flaky, label="t/flaky", sleep=sleeps.append
+        )
+        assert out == "ok" and calls["n"] == 3
+        assert len(sleeps) == 2
+        assert retry.retry_counts["t/flaky"] >= 2
+
+    def test_fatal_raises_immediately(self):
+        calls = {"n": 0}
+
+        def broken():
+            calls["n"] += 1
+            raise ValueError("wrong input")
+
+        with pytest.raises(ValueError):
+            retry.retry_call(broken, label="t/fatal", sleep=lambda s: None)
+        assert calls["n"] == 1
+
+    def test_exhaustion_reraises_original(self):
+        policy = retry.RetryPolicy(max_attempts=3, base_delay_s=0.0)
+        calls = {"n": 0}
+
+        def always():
+            calls["n"] += 1
+            raise TimeoutError("forever")
+
+        with pytest.raises(TimeoutError):
+            retry.retry_call(
+                always, label="t/exhaust", policy=policy,
+                sleep=lambda s: None,
+            )
+        assert calls["n"] == 3
+
+    def test_backoff_is_exponential_capped_and_seeded(self):
+        policy = retry.RetryPolicy(
+            base_delay_s=0.1, multiplier=2.0, max_delay_s=0.3, jitter=0.5
+        )
+        rng1, rng2 = random.Random("s"), random.Random("s")
+        d = [policy.delay(a, rng1) for a in range(4)]
+        # nominal 0.1, 0.2, 0.3(capped), 0.3 with +/-50% jitter
+        for i, nominal in enumerate([0.1, 0.2, 0.3, 0.3]):
+            assert nominal * 0.5 <= d[i] <= nominal * 1.5
+        assert d == [policy.delay(a, rng2) for a in range(4)]  # seeded
+
+    def test_retries_emit_telemetry(self):
+        from progen_tpu.telemetry.spans import Telemetry, configure
+
+        records = []
+        configure(sink=records.append)
+        try:
+            calls = {"n": 0}
+
+            def once():
+                calls["n"] += 1
+                if calls["n"] == 1:
+                    raise ConnectionResetError("blip")
+                return 1
+
+            retry.retry_call(once, label="t/tel", sleep=lambda s: None)
+        finally:
+            configure()
+        evs = [r for r in records if r.get("ev") == "retry"]
+        assert len(evs) == 1
+        assert evs[0]["label"] == "t/tel" and evs[0]["attempt"] == 1
+        assert "ConnectionResetError" in evs[0]["error"]
+
+    def test_policy_from_env(self, monkeypatch):
+        monkeypatch.setenv("PROGEN_RETRY_ATTEMPTS", "7")
+        monkeypatch.setenv("PROGEN_RETRY_BASE_S", "0.25")
+        monkeypatch.setenv("PROGEN_RETRY_MAX_S", "junk")  # ignored
+        p = retry.policy_from_env()
+        assert p.max_attempts == 7
+        assert p.base_delay_s == 0.25
+        assert p.max_delay_s == retry.RetryPolicy().max_delay_s
+
+
+# ------------------------------------------------------------------ chaos
+
+
+class TestChaosRules:
+    def test_parse_grammar(self):
+        rules = chaos._parse(
+            "ckpt/save:0.3, data/read:kill, ckpt/io/meta_read:fail@2,"
+            "train/loss:spike@3,x:nan@1,y:kill@5"
+        )
+        assert rules["ckpt/save"].kind == "prob"
+        assert rules["ckpt/save"].arg == 0.3
+        assert rules["data/read"].kind == "kill"
+        assert rules["data/read"].arg == 1
+        assert rules["ckpt/io/meta_read"].kind == "fail"
+        assert rules["ckpt/io/meta_read"].arg == 2
+        assert rules["train/loss"].kind == "spike"
+        assert rules["x"].kind == "nan"
+        assert rules["y"].arg == 5
+
+    def test_parse_rejects_bad_specs(self):
+        with pytest.raises(ValueError):
+            chaos._parse("noseparator")
+        with pytest.raises(ValueError):
+            chaos._parse("a:1.5")
+
+    def test_fail_at_n_fires_exactly_once(self):
+        inj = chaos.ChaosInjector("site:fail@2")
+        inj.on_site("site")  # hit 1: clean
+        with pytest.raises(chaos.ChaosError):
+            inj.on_site("site")  # hit 2: boom
+        inj.on_site("site")  # hit 3: clean again
+        inj.on_site("other-site")  # unmatched targets never fire
+
+    def test_probability_rule_is_seeded(self):
+        hits = []
+        for _ in range(2):
+            inj = chaos.ChaosInjector("s:0.5", seed=7)
+            seq = []
+            for _ in range(32):
+                try:
+                    inj.on_site("s")
+                    seq.append(0)
+                except chaos.ChaosError:
+                    seq.append(1)
+            hits.append(seq)
+        assert hits[0] == hits[1]
+        assert 0 < sum(hits[0]) < 32
+
+    def test_perturb_spike_and_nan(self):
+        inj = chaos.ChaosInjector("l:spike@2,m:nan@1")
+        assert inj.perturb("l", 1.0) == 1e9
+        assert inj.perturb("l", 1.0) == 1e9
+        assert inj.perturb("l", 1.0) == 1.0  # budget spent
+        assert np.isnan(inj.perturb("m", 1.0))
+        assert inj.perturb("m", 1.0) == 1.0
+        assert inj.perturb("unruled", 3.0) == 3.0
+
+    def test_install_hooks_span_entry_and_uninstall(self, monkeypatch):
+        from progen_tpu import telemetry
+        from progen_tpu.telemetry import spans
+
+        monkeypatch.setenv("PROGEN_CHAOS", "t/span:fail@1")
+        chaos.install_from_env()
+        try:
+            assert chaos.maybe_inject in spans.SPAN_ENTRY_HOOKS
+            with pytest.raises(chaos.ChaosError):
+                with telemetry.span("t/span"):
+                    pass
+            # the span still closed (E record emitted) despite the raise
+            recent = telemetry.get_telemetry().recent_spans(4)
+            assert any(r["span"] == "t/span" for r in recent)
+        finally:
+            chaos.uninstall()
+        assert chaos.maybe_inject not in spans.SPAN_ENTRY_HOOKS
+        monkeypatch.setenv("PROGEN_CHAOS", "")
+        assert chaos.install_from_env() is None
+
+    def test_retry_absorbs_injected_transient_fault(self):
+        chaos.install("t/io:fail@1")
+        try:
+            out = retry.retry_call(
+                lambda: "fine", label="t/io", sleep=lambda s: None
+            )
+        finally:
+            chaos.uninstall()
+        assert out == "fine"
+        assert retry.retry_counts.get("t/io", 0) >= 1
+
+
+# ---------------------------------------------------------------- anomaly
+
+
+class TestLossSentinel:
+    def test_nonfinite_always_anomalous_even_in_warmup(self):
+        s = anomaly.LossSentinel(patience=2)
+        assert s.observe(float("nan")) == anomaly.SPIKE
+        assert s.observe(float("inf")) == anomaly.ROLLBACK
+
+    def test_nonfinite_grad_norm_is_anomalous(self):
+        s = anomaly.LossSentinel(patience=3)
+        assert s.observe(1.0, float("nan")) == anomaly.SPIKE
+
+    def test_statistical_spike_after_warmup(self):
+        s = anomaly.LossSentinel(factor=6.0, patience=3, warmup=10)
+        rng = random.Random(0)
+        for _ in range(20):
+            assert s.observe(2.0 + 0.05 * rng.random()) == anomaly.OK
+        assert s.observe(50.0) == anomaly.SPIKE
+        # the spike never entered the baseline: normal values are OK again
+        assert s.observe(2.02) == anomaly.OK
+        assert s.consecutive == 0
+
+    def test_no_statistical_flag_during_warmup(self):
+        s = anomaly.LossSentinel(warmup=10)
+        for v in (5.0, 100.0, 3.0, 80.0):  # wild but finite, in warmup
+            assert s.observe(v) == anomaly.OK
+
+    def test_consecutive_escalates_to_rollback(self):
+        s = anomaly.LossSentinel(factor=6.0, patience=3, warmup=5)
+        for _ in range(10):
+            s.observe(2.0)
+        assert s.observe(90.0) == anomaly.SPIKE
+        assert s.observe(95.0) == anomaly.SPIKE
+        assert s.observe(99.0) == anomaly.ROLLBACK
+        s.reset()
+        assert s.consecutive == 0 and s.mean is None
+
+    def test_factor_zero_disables_statistical_detection(self):
+        s = anomaly.LossSentinel(factor=0.0, warmup=0)
+        for v in (1.0, 1e8, 1.0):
+            assert s.observe(v) == anomaly.OK
+        assert s.observe(float("nan")) == anomaly.SPIKE
+
+    def test_consistent_flag_single_process_identity(self):
+        assert anomaly.consistent_flag(True) is True
+        assert anomaly.consistent_flag(False) is False
+
+
+# --------------------------------------------------- watchdog escalation
+
+
+class TestWatchdogEscalation:
+    def test_escalates_after_n_consecutive_reports(self):
+        from progen_tpu.telemetry.spans import Telemetry
+        from progen_tpu.telemetry.watchdog import StallWatchdog
+
+        buf = io.StringIO()
+        tel = Telemetry()
+        records = []
+        tel.set_sink(records.append)
+        fake_mem = [{"device": "0", "bytes_in_use": 123}]
+        with tel.span("train/step"):
+            wd = StallWatchdog(
+                0.15, file=buf, telemetry=tel, poll_s=0.02,
+                escalate_after=2, memory_stats_fn=lambda: fake_mem,
+            )
+            with wd:
+                deadline = time.time() + 5.0
+                while wd.escalation_count == 0 and time.time() < deadline:
+                    time.sleep(0.02)
+        assert wd.escalation_count >= 1
+        assert wd.fire_count >= 2  # re-reported, then escalated
+        esc = [r for r in records if r.get("ev") == "stall_escalation"]
+        assert esc and esc[0]["memory_stats"] == fake_mem
+        assert esc[0]["consecutive_reports"] == 2
+        assert esc[0]["open_spans"][0]["span"] == "train/step"
+        assert "ESCALATION" in buf.getvalue()
+
+    def test_beat_resets_escalation_ladder(self):
+        from progen_tpu.telemetry.spans import Telemetry
+        from progen_tpu.telemetry.watchdog import StallWatchdog
+
+        wd = StallWatchdog(
+            0.2, file=io.StringIO(), telemetry=Telemetry(), poll_s=0.02,
+            escalate_after=3,
+        )
+        with wd:
+            deadline = time.time() + 5.0
+            while not wd.fired and time.time() < deadline:
+                time.sleep(0.02)
+            wd.beat()  # stall cleared after the first report
+            time.sleep(0.1)
+        assert wd.escalation_count == 0
+
+    def test_default_is_legacy_once_per_stall(self):
+        from progen_tpu.telemetry.spans import Telemetry
+        from progen_tpu.telemetry.watchdog import StallWatchdog
+
+        wd = StallWatchdog(
+            0.1, file=io.StringIO(), telemetry=Telemetry(), poll_s=0.02
+        )
+        with wd:
+            time.sleep(0.5)  # several deadlines deep into ONE stall
+        assert wd.fire_count == 1
+
+
+# --------------------------------------- train-loop chaos integration
+
+TOML = """num_tokens = 256
+dim = 32
+depth = 2
+heads = 2
+dim_head = 16
+window_size = 8
+seq_len = 32
+global_mlp_depth = 1
+ff_mult = 2
+dtype = "float32"
+"""
+
+DATA_TOML = """read_from = "{fasta}"
+write_to = "{out}"
+num_samples = 30
+max_seq_len = 28
+prob_invert_seq_annotation = 0.5
+fraction_valid_data = 0.2
+num_sequences_per_file = 50
+sort_annotations = true
+"""
+
+
+@pytest.fixture(scope="module")
+def workspace(tmp_path_factory):
+    from click.testing import CliRunner
+
+    root = tmp_path_factory.mktemp("resilience")
+    (root / "configs" / "model").mkdir(parents=True)
+    (root / "configs" / "data").mkdir(parents=True)
+    (root / "configs" / "model" / "default.toml").write_text(TOML)
+    rng = random.Random(0)
+    aas = "ACDEFGHIKLMNPQRSTVWY"
+    fasta = root / "toy.fasta"
+    with fasta.open("w") as f:
+        for i in range(40):
+            tax = rng.choice(["Homo sapiens", "Acinetobacter"])
+            seq = "".join(rng.choice(aas) for _ in range(rng.randint(8, 24)))
+            f.write(f">U{i:03d} toy n=1 Tax={tax} TaxID=1 RepID=T\n{seq}\n")
+    (root / "configs" / "data" / "default.toml").write_text(
+        DATA_TOML.format(fasta=fasta, out=root / "train_data")
+    )
+    from progen_tpu.cli.generate_data import main as gen_main
+
+    res = CliRunner().invoke(
+        gen_main, ["--data_dir", str(root / "configs" / "data")]
+    )
+    assert res.exit_code == 0, res.output
+    return root
+
+
+def _train_args(workspace, ckpt_dir, steps, **extra):
+    args = [
+        "--wandb_off", "--batch_size", "4", "--grad_accum_every", "1",
+        "--num_steps", str(steps), "--validate_every", "1000",
+        "--sample_every", "1000", "--checkpoint_every", "2",
+        "--seq_len", "32",
+        "--config_path", str(workspace / "configs" / "model"),
+        "--data_path", str(workspace / "train_data"),
+        "--checkpoint_path", str(ckpt_dir),
+    ]
+    for k, v in extra.items():
+        args += [f"--{k}", str(v)]
+    return args
+
+
+class TestTrainChaos:
+    def test_isolated_spike_is_skipped_and_run_completes(
+        self, workspace, tmp_path, monkeypatch
+    ):
+        from click.testing import CliRunner
+
+        from progen_tpu.cli.train import main as train_main
+
+        monkeypatch.chdir(workspace)
+        monkeypatch.setenv("PROGEN_CHAOS", "train/loss:nan@1")
+        res = CliRunner().invoke(
+            train_main,
+            _train_args(workspace, tmp_path / "ck", 6, anomaly_patience=3),
+        )
+        assert res.exit_code == 0, res.output
+        assert "anomaly:" in res.output
+        assert "rollback" not in res.output.lower().replace(
+            "before rollback", ""
+        )
+        assert chaos._INJECTOR is None  # uninstalled on the way out
+
+    def test_persistent_anomaly_rolls_back_and_completes(
+        self, workspace, tmp_path, monkeypatch
+    ):
+        from click.testing import CliRunner
+
+        from progen_tpu.cli.train import main as train_main
+
+        monkeypatch.chdir(workspace)
+        # nan (not spike): non-finite is anomalous even inside the
+        # sentinel's statistical warmup, so a 3-NaN streak crosses
+        # patience=3 in a run this short. A checkpoint lands at i==0
+        # (--checkpoint_every 2), so the rollback has somewhere to go.
+        monkeypatch.setenv("PROGEN_CHAOS", "train/loss:nan@3")
+        ck = tmp_path / "ck"
+        res = CliRunner().invoke(
+            train_main,
+            _train_args(workspace, ck, 8, anomaly_patience=3),
+        )
+        assert res.exit_code == 0, res.output
+        assert "anomaly rollback 1/3" in res.output
+        # the run survived: a final checkpoint exists and is restorable
+        from progen_tpu.checkpoint import get_checkpoint_fns
+
+        _, get_last, _ = get_checkpoint_fns(str(ck))
+        pkg = get_last.peek()
+        assert pkg is not None
+        # rollback skipped ahead: the cursor advanced past the anomaly
+        assert pkg.next_seq_index > 0
+
+    def test_transient_ckpt_fault_is_retried_through(
+        self, workspace, tmp_path, monkeypatch
+    ):
+        from click.testing import CliRunner
+
+        from progen_tpu.cli.train import main as train_main
+        from progen_tpu.resilience.retry import retry_counts
+
+        monkeypatch.chdir(workspace)
+        monkeypatch.setenv("PROGEN_CHAOS", "ckpt/io/meta_write:fail@1")
+        before = retry_counts.get("ckpt/io/meta_write", 0)
+        res = CliRunner().invoke(
+            train_main, _train_args(workspace, tmp_path / "ck", 2)
+        )
+        assert res.exit_code == 0, res.output
+        assert retry_counts.get("ckpt/io/meta_write", 0) > before

@@ -201,3 +201,97 @@ class TestMetrics:
         assert rec["serve/requests_completed"] == 1.0
         assert rec["_step"] == 1
         assert "serve/decode_tokens_per_s" in rec
+
+
+class TestDeadlines:
+    """Queue-TTL expiry and graceful drain: queued requests past their
+    deadline (or shed by a drain) are rejected with machine-readable
+    reasons BEFORE admission, never mid-decode."""
+
+    def _sched(self, model_and_params, max_slots=1, max_queue=8):
+        model, params = model_and_params
+        clock = {"t": 0.0}
+        engine = ServeEngine(model, params, max_slots=max_slots, max_len=32)
+        sched = Scheduler(
+            engine, max_queue=max_queue, clock=lambda: clock["t"]
+        )
+        return sched, clock
+
+    def test_expired_queued_request_rejected_not_admitted(
+        self, model_and_params
+    ):
+        from progen_tpu.serving import REJECT_DEADLINE
+
+        sched, clock = self._sched(model_and_params, max_slots=1)
+        # r0 occupies the only slot; r1 waits in queue with a 5s TTL
+        assert sched.submit(_req(0, length=12))[0]
+        assert sched.submit(_req(1, length=4, deadline_s=5.0))[0]
+        sched.step()  # admits r0 only (one slot)
+        clock["t"] = 10.0  # r1's deadline passes while queued
+        events, comps = sched.step()
+        shed = sched.pop_expired()
+        assert [(r.id, reason) for r, reason in shed] == [
+            ("q1", REJECT_DEADLINE)
+        ]
+        assert sched.queue_depth == 0
+        m = sched.metrics.snapshot()
+        assert m["requests_expired"] == 1
+        assert m["rejected_deadline_exceeded"] == 1
+        assert m["requests_rejected"] == 1
+        # r1 never touched a slot; r0 still completes normally
+        _, comps2 = sched.run_to_completion(max_steps=300)
+        done = {c.request_id for c in list(comps) + list(comps2)}
+        assert done == {"q0"}
+        # pop_expired drains: a second call reports nothing
+        assert sched.pop_expired() == []
+
+    def test_live_deadline_not_expired_and_inflight_immune(
+        self, model_and_params
+    ):
+        sched, clock = self._sched(model_and_params, max_slots=1)
+        assert sched.submit(_req(0, length=12, deadline_s=100.0))[0]
+        sched.step()  # admitted within deadline
+        clock["t"] = 500.0  # WAY past the deadline — but it's on a slot
+        _, comps = sched.run_to_completion(max_steps=300)
+        assert [c.request_id for c in comps] == ["q0"]
+        assert sched.metrics.snapshot().get("requests_expired", 0) == 0
+
+    def test_invalid_deadline_rejected_at_submit(self, model_and_params):
+        sched, _ = self._sched(model_and_params)
+        ok, reason = sched.submit(_req(0, deadline_s=-1.0))
+        assert not ok and "deadline_s" in reason
+        assert sched.metrics.snapshot()["rejected_invalid"] == 1
+
+    def test_drain_queue_sheds_queued_keeps_inflight(self, model_and_params):
+        from progen_tpu.serving import REJECT_DRAINING
+
+        sched, _ = self._sched(model_and_params, max_slots=1)
+        assert sched.submit(_req(0, length=8))[0]
+        sched.step()  # r0 on the slot
+        assert sched.submit(_req(1, length=8))[0]
+        assert sched.submit(_req(2, length=8))[0]
+        assert sched.drain_queue() == 2
+        shed = sched.pop_expired()
+        assert [(r.id, reason) for r, reason in shed] == [
+            ("q1", REJECT_DRAINING), ("q2", REJECT_DRAINING)
+        ]
+        m = sched.metrics.snapshot()
+        assert m["rejected_draining"] == 2 and m["queue_depth"] == 0
+        # the in-flight request still runs to completion
+        _, comps = sched.run_to_completion(max_steps=300)
+        assert [c.request_id for c in comps] == ["q0"]
+
+    def test_deadline_counters_in_prometheus_exposition(
+        self, model_and_params
+    ):
+        from progen_tpu.telemetry import prometheus_text
+
+        sched, clock = self._sched(model_and_params, max_slots=1)
+        assert sched.submit(_req(0, length=12))[0]
+        assert sched.submit(_req(1, length=4, deadline_s=1.0))[0]
+        sched.step()
+        clock["t"] = 2.0
+        sched.step()
+        text = prometheus_text(sched.metrics)
+        assert "progen_serve_rejected_deadline_exceeded_total 1" in text
+        assert "progen_serve_requests_expired_total 1" in text

@@ -13,7 +13,17 @@ import jax
 import jax.numpy as jnp
 import pytest
 
-from progen_tpu.ops.pallas_attention import pallas_local_attention
+from progen_tpu.ops.pallas_attention import (
+    PALLAS_API_OK,
+    pallas_local_attention,
+)
+
+pytestmark = pytest.mark.skipif(
+    not PALLAS_API_OK,
+    reason="installed jax predates the Pallas kernel API family "
+    "(jax.typeof / pltpu.CompilerParams) — the TPU lowering under "
+    "test cannot even trace here",
+)
 
 
 def _export_for_tpu(fn, *args):
