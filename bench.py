@@ -71,6 +71,18 @@ _LOG_DIR = _REPO / "runs" / "bench_logs"
 
 _WATCHDOG = None  # phase-child stall watchdog; beaten by _mark
 
+# phase-child goodput ledger: the _phase entry point owns one per run and
+# benches credit compile/step/checkpoint time through _account; the phase
+# result then carries a ``goodput`` report (and a ``goodput`` event lands
+# in the phase child's events.jsonl) so a slow bench is attributable —
+# compile-bound vs step-bound vs checkpoint-bound — straight from the JSON
+_PHASE_LEDGER = None
+
+
+def _account(bucket: str, seconds) -> None:
+    if _PHASE_LEDGER is not None and seconds is not None:
+        _PHASE_LEDGER.account(bucket, float(seconds))
+
 
 def _mark(msg: str) -> None:
     """Progress marker on stderr (streamed to the phase log by the
@@ -345,6 +357,7 @@ def _train_bench(config_name: str, *, use_pallas=None, recipe=None,
         # before it)
         _value_fence(metrics["loss"])
         compile_s = time.perf_counter() - t0
+        _account("compile", compile_s)
         _mark(f"compile+first step done in {compile_s:.1f}s; timing "
               f"{n_iters} iters")
 
@@ -359,6 +372,7 @@ def _train_bench(config_name: str, *, use_pallas=None, recipe=None,
                 state, metrics = compiled(state, device_batch)
             loss_val = float(metrics["loss"])
         dt = time.perf_counter() - t0
+        _account("step", dt)
         _mark(f"timed loop done in {dt:.1f}s")
 
     tokens_per_step = grad_accum * micro_bs * config.seq_len
@@ -850,6 +864,7 @@ def _sustain_bench() -> dict:
         state, m = step(state, batches[0])  # compile + step 1
         _value_fence(m["loss"])
         compile_s = time.perf_counter() - t0
+        _account("compile", compile_s)
         _mark(f"sustain: compile+step1 in {compile_s:.1f}s")
 
         steps_done = 1
@@ -867,6 +882,7 @@ def _sustain_bench() -> dict:
                 steps_done += 1
             _value_fence(m["loss"])
             dt = time.perf_counter() - t0
+            _account("step", dt)
             row = {
                 "step": steps_done,
                 "chunk_steps": n,
@@ -884,6 +900,7 @@ def _sustain_bench() -> dict:
                     run_id=None,
                 ))
                 ckpt_block_s = time.perf_counter() - t0
+                _account("checkpoint", ckpt_block_s)
                 _mark(f"sustain: async ckpt at step {steps_done} "
                       f"(blocked {ckpt_block_s:.2f}s)")
                 # the step the restore must reproduce bit-for-bit
@@ -909,6 +926,7 @@ def _sustain_bench() -> dict:
             r_shardings = train_state_shardings(boxed, mesh)
             pkg = get_last(sharded_abstract_state(abstract, r_shardings))
             restore_s = time.perf_counter() - t0
+            _account("checkpoint", restore_s)
             _mark(f"sustain: restore in {restore_s:.1f}s from step "
                   f"{pkg.next_seq_index}")
             r_state = pkg.state
@@ -1761,6 +1779,16 @@ if __name__ == "__main__":
                 max(60.0, deadline * 0.6), file=sys.stderr,
                 escalate_after=2,
             ).start()
+        # phase-child telemetry: spans + injected faults + the goodput
+        # report land in the shared bench event stream (same file the
+        # orchestrator writes its bench/<name> spans to — appends from
+        # both processes are line-atomic)
+        from progen_tpu import telemetry as _tel
+        from progen_tpu.telemetry import GoodputLedger as _Ledger
+
+        _LOG_DIR.mkdir(parents=True, exist_ok=True)
+        _tel.configure(path=_LOG_DIR / "events.jsonl")
+        _PHASE_LEDGER = _Ledger()
         try:
             if os.environ.get("BENCH_REQUIRE_TPU") == "1":
                 # orchestrated child: the parent already probed; a dead
@@ -1782,6 +1810,16 @@ if __name__ == "__main__":
                 # firing mid-teardown would turn this valid result into
                 # an "exit 1" the parent discards
                 signal.alarm(0)
+            # close the phase's goodput books: the report rides the phase
+            # JSON (BENCH_DETAIL) and the event stream (export-trace
+            # renders it as a counter track on the bench timeline)
+            _gp = _PHASE_LEDGER.report()
+            if isinstance(result, dict) and "error" not in result:
+                result.setdefault("goodput", _gp)
+            _tel.get_telemetry().emit({
+                "ev": "goodput", "ts": time.time(),
+                "phase": sys.argv[2], **_gp,
+            })
             print(json.dumps(result))
         except TimeoutError as e:
             # clean-unwind path for the self-deadline: report as a phase

@@ -41,6 +41,7 @@ from jax.sharding import NamedSharding
 
 from progen_tpu import telemetry
 from progen_tpu.resilience.retry import retry_call
+from progen_tpu.telemetry.registry import get_registry
 
 CKPT_PREFIX = "ckpt_"
 CORRUPT_SUFFIX = ".corrupt"
@@ -342,8 +343,44 @@ def get_checkpoint_fns(
         with telemetry.span("ckpt/save", async_mode=async_save):
             return _save(package)
 
+    def _check_error() -> None:
+        """Non-blocking poll of the background commit thread; the train
+        loop calls this once per step so a fatal commit error surfaces at
+        the NEXT step rather than the next flush (which may be minutes of
+        silently-doomed training away). On failure: emit a
+        ``ckpt_commit_failed`` event, drop the pending finalizer (a
+        failed commit must never publish meta.json — the incomplete dir
+        stays meta-less and restore skips it), retire the checkpointer
+        (so the finally-path ``close()`` is a clean no-op), and re-raise
+        to the step loop."""
+        ckptr = _async.get("ckptr")
+        if ckptr is None:
+            return  # sync mode / nothing in flight
+        check = getattr(ckptr, "check_for_errors", None)
+        if check is None:
+            return  # orbax without the poll API: flush-time surfacing
+        try:
+            check()
+        except BaseException as e:
+            get_registry().inc("ckpt_commit_failures")
+            telemetry.get_telemetry().emit({
+                "ev": "ckpt_commit_failed",
+                "ts": time.time(),
+                "error": f"{type(e).__name__}: {e}",
+            })
+            _async.pop("pending", None)
+            bad = _async.pop("ckptr", None)
+            if bad is not None:
+                try:
+                    bad.close()
+                except Exception:
+                    pass
+            raise
+
     save.flush = _finalize_pending  # await + publish the in-flight save
     save.close = _close  # flush + stop the background commit thread
+    save.check_error = _check_error  # per-step async commit health poll
+    save._async = _async  # test seam: inject a failing checkpointer
 
     def _complete(candidates):
         return [p for p in candidates if _exists(p / "meta.json")]
@@ -360,6 +397,7 @@ def get_checkpoint_fns(
             f"[checkpoint] quarantining {getattr(p, 'name', p)}: {reason}",
             flush=True,
         )
+        get_registry().inc("ckpt_quarantines")
         telemetry.get_telemetry().emit({
             "ev": "ckpt_quarantine",
             "ts": time.time(),

@@ -1,0 +1,199 @@
+"""Run-analysis CLI over the telemetry event stream.
+
+Pure-host tooling — no jax, no device, no config: it reads the
+``events.jsonl`` (and optionally ``metrics.jsonl``) any train/bench/
+serve run leaves behind and turns them into the two artifacts a
+post-mortem actually wants:
+
+  * ``export-trace`` — Chrome Trace Event / Perfetto JSON. Load the
+    output at https://ui.perfetto.dev (or ``chrome://tracing``): span
+    slices per host/thread, instant markers for retries/anomalies/
+    stalls/chaos, counter tracks for step_ms, MFU, goodput buckets,
+    and HBM.
+  * ``summarize`` — terminal report: per-host goodput table with the
+    cross-host skew/straggler breakdown, per-span-name p50/p95/p99
+    latency (reservoir quantiles over every completed span), and
+    resilience event counts.
+
+Run: python -m progen_tpu.cli.telemetry export-trace logs/events.jsonl
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import click
+
+from progen_tpu.telemetry.goodput import goodput_skew
+from progen_tpu.telemetry.registry import _Timing
+from progen_tpu.telemetry.trace import (
+    INSTANT_EVENTS,
+    export_trace,
+    iter_jsonl,
+)
+
+
+@click.group()
+def main():
+    """Analyze telemetry event streams (events.jsonl)."""
+
+
+@main.command("export-trace")
+@click.argument(
+    "events", type=click.Path(exists=True, dir_okay=False)
+)
+@click.option(
+    "--metrics",
+    type=click.Path(dir_okay=False),
+    default=None,
+    help="metrics.jsonl for perf counter tracks "
+    "(default: sibling of EVENTS when present)",
+)
+@click.option(
+    "--out",
+    type=click.Path(dir_okay=False),
+    default=None,
+    help="output trace path (default: trace.json beside EVENTS)",
+)
+def export_trace_cmd(events, metrics, out):
+    """Convert EVENTS (events.jsonl) to Perfetto trace-event JSON."""
+    events = Path(events)
+    if metrics is None:
+        sibling = events.with_name("metrics.jsonl")
+        metrics = str(sibling) if sibling.exists() else None
+    if out is None:
+        out = str(events.with_name("trace.json"))
+    trace = export_trace(events, out, metrics_path=metrics)
+    n = len(trace["traceEvents"])
+    click.echo(f"wrote {out} ({n} trace events)")
+    click.echo("open at https://ui.perfetto.dev or chrome://tracing")
+
+
+def _host_reports(events_path, metrics_path) -> list:
+    """Latest per-host goodput reports. Primary source: the
+    ``goodput_host`` records every host emits at end of run. Fallback
+    for runs predating per-host emission: the last metrics.jsonl row
+    carrying ``goodput_pct`` becomes host 0's report."""
+    by_host: dict = {}
+    for rec in iter_jsonl(events_path):
+        if rec.get("ev") == "goodput_host" and "host" in rec:
+            by_host[int(rec["host"])] = {
+                k: v for k, v in rec.items()
+                if k not in ("ev", "ts", "host", "pid")
+            }
+    if by_host:
+        return [by_host[h] for h in sorted(by_host)]
+    if metrics_path is not None and Path(metrics_path).exists():
+        last = None
+        for rec in iter_jsonl(metrics_path):
+            if "goodput_pct" in rec:
+                last = rec
+        if last is not None:
+            return [{
+                k: v for k, v in last.items()
+                if k == "goodput_pct" or k.startswith("bucket_s/")
+                or k == "wall_s"
+            }]
+    return []
+
+
+@main.command("summarize")
+@click.argument(
+    "events", type=click.Path(exists=True, dir_okay=False)
+)
+@click.option(
+    "--metrics",
+    type=click.Path(dir_okay=False),
+    default=None,
+    help="metrics.jsonl (default: sibling of EVENTS when present)",
+)
+@click.option(
+    "--spans",
+    "top_spans",
+    type=int,
+    default=20,
+    show_default=True,
+    help="max span families in the latency table",
+)
+def summarize_cmd(events, metrics, top_spans):
+    """Per-host goodput + skew, span latency quantiles, event counts."""
+    events = Path(events)
+    if metrics is None:
+        sibling = events.with_name("metrics.jsonl")
+        metrics = str(sibling) if sibling.exists() else None
+
+    reports = _host_reports(events, metrics)
+    if reports:
+        click.echo("== goodput (per host) ==")
+        buckets = sorted(
+            {k for rep in reports for k in rep if k.startswith("bucket_s/")}
+        )
+        header = f"{'host':>4} {'wall_s':>9} {'goodput%':>9}"
+        for b in buckets:
+            header += f" {b.split('/', 1)[1]:>11}"
+        click.echo(header)
+        for i, rep in enumerate(reports):
+            line = (
+                f"{i:>4} {rep.get('wall_s', 0.0):>9.2f} "
+                f"{rep.get('goodput_pct', 0.0):>9.2f}"
+            )
+            for b in buckets:
+                line += f" {float(rep.get(b, 0.0)):>11.3f}"
+            click.echo(line)
+        if len(reports) > 1:
+            click.echo("")
+            click.echo("== cross-host skew (straggler table) ==")
+            skew = goodput_skew(reports)
+            click.echo(
+                f"{'bucket':<14} {'min':>10} {'max':>10} {'skew':>10}"
+            )
+            for name, row in skew.items():
+                if not isinstance(row, dict):
+                    continue
+                click.echo(
+                    f"{name:<14} {row['min']:>10.3f} {row['max']:>10.3f} "
+                    f"{row['skew']:>10.3f}  straggler host "
+                    f"{row['straggler']}"
+                )
+        click.echo("")
+
+    timings: dict = {}
+    counts: dict = {}
+    for rec in iter_jsonl(events):
+        ev = rec.get("ev")
+        if ev == "E" and "dur_s" in rec:
+            timings.setdefault(
+                str(rec.get("span", "?")), _Timing()
+            ).observe(float(rec["dur_s"]))
+        elif ev not in ("B", "E", None):
+            counts[str(ev)] = counts.get(str(ev), 0) + 1
+
+    if timings:
+        click.echo("== span latency (s) ==")
+        click.echo(
+            f"{'span':<28} {'count':>6} {'p50':>9} {'p95':>9} "
+            f"{'p99':>9} {'total':>9}"
+        )
+        families = sorted(
+            timings.items(), key=lambda kv: kv[1].sum, reverse=True
+        )
+        for name, t in families[:top_spans]:
+            click.echo(
+                f"{name:<28} {t.count:>6} {t.quantile(0.5):>9.4f} "
+                f"{t.quantile(0.95):>9.4f} {t.quantile(0.99):>9.4f} "
+                f"{t.sum:>9.3f}"
+            )
+        if len(families) > top_spans:
+            click.echo(f"... {len(families) - top_spans} more (--spans)")
+        click.echo("")
+
+    if counts:
+        click.echo("== events ==")
+        order = [e for e in INSTANT_EVENTS if e in counts]
+        order += sorted(set(counts) - set(order))
+        for ev in order:
+            click.echo(f"{ev:<24} {counts[ev]:>6}")
+
+
+if __name__ == "__main__":
+    main()

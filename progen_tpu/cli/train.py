@@ -136,6 +136,15 @@ class AnomalyRollback(Exception):
                    "last good checkpoint and skipping ahead in the data "
                    "stream; isolated spikes are skipped (the train step's "
                    "finite gate already refused any non-finite update)")
+@click.option("--prom_file", default=None, type=str,
+              help="write train-loop Prometheus text exposition here "
+                   "(goodput %, step_ms quantiles, tokens/s/chip, MFU, HBM "
+                   "gauges, resilience counters; atomic rewrite on the "
+                   "--validate_every cadence and at exit; node-exporter "
+                   "textfile-collector compatible)")
+@click.option("--prom_port", default=0,
+              help="serve the same train-loop exposition over HTTP on "
+                   "this localhost port (0 = off)")
 def main(
     seed,
     batch_size,
@@ -179,6 +188,8 @@ def main(
     stall_escalate_after,
     anomaly_factor,
     anomaly_patience,
+    prom_file,
+    prom_port,
 ):
     from progen_tpu.checkpoint import Package, get_checkpoint_fns
     from progen_tpu.config import ProGenConfig, load_toml_config
@@ -225,6 +236,23 @@ def main(
     # unless the env asks for it; uninstalled in the finally below so an
     # in-process caller (tests) never leaks rules into the next run
     chaos.install_from_env()
+
+    # shared metrics registry: resilience wiring (retry/chaos/watchdog/
+    # checkpoint/anomaly) increments counters here as a side effect of the
+    # run; reset keeps in-process reruns (tests) from bleeding counts, and
+    # pre-seeding declares every resilience family at 0 so the Prometheus
+    # exposition always carries them (an absent counter and a zero counter
+    # are different dashboards)
+    from progen_tpu.telemetry import get_registry
+
+    reg = get_registry()
+    reg.reset()
+    for _c in (
+        "retries", "anomalies", "anomaly_rollbacks", "chaos_injections",
+        "stalls", "stall_escalations", "ckpt_quarantines",
+        "ckpt_commit_failures",
+    ):
+        reg.inc(_c, 0)
 
     reset_ckpt, get_last, save_ckpt = get_checkpoint_fns(
         checkpoint_path, keep_last_n=checkpoint_keep_n,
@@ -386,12 +414,41 @@ def main(
     from progen_tpu.telemetry import (
         GoodputLedger,
         StallWatchdog,
+        emit_per_host_goodput,
         hbm_gauges,
+        prometheus_text,
+        start_prometheus_server,
         step_print,
+        write_prometheus,
     )
 
     telemetry.configure(sink=tracker.log_event)
     ledger = GoodputLedger()
+
+    # --- train-loop Prometheus: the registry already carries the
+    # resilience counters and step_s reservoir; goodput + HBM ride in as
+    # gauges at render time so file and HTTP expositions agree
+    def _render_prom() -> str:
+        reg.set_gauges({
+            k.replace("/", "_"): v
+            for k, v in ledger.report().items()
+            if isinstance(v, (int, float))
+        })
+        reg.set_gauges(hbm_gauges())
+        return prometheus_text(reg, prefix="progen_train_")
+
+    def publish_prom() -> None:
+        if prom_file and is_coordinator():
+            write_prometheus(prom_file, _render_prom())
+
+    prom_srv = None
+    if prom_port and is_coordinator():
+        prom_srv = start_prometheus_server(_render_prom, port=prom_port)
+        print(
+            f"prometheus on http://127.0.0.1:"
+            f"{prom_srv.server_address[1]}/metrics",
+            file=sys.stderr,
+        )
 
     # --- data
     num_train, train_iter_fn = iterator_from_tfrecords_folder(data_path)
@@ -580,6 +637,7 @@ def main(
                         + f"; {sentinel.consecutive}/{sentinel.patience} "
                         "consecutive before rollback",
                     )
+                reg.inc("anomalies")
                 telemetry.get_telemetry().emit({
                     "ev": "anomaly", "ts": time.time(), "step": p_step,
                     "loss": loss, "grad_norm": grad_norm,
@@ -587,6 +645,15 @@ def main(
                     "consecutive": sentinel.consecutive,
                 })
             perf = timer.tick(effective_batch * config.seq_len)
+            if perf is not None:
+                # the step_s reservoir is what the Prometheus summary
+                # quantiles render from; throughput/MFU ride as gauges
+                reg.observe("step_s", perf["step_ms"] / 1000.0)
+                reg.set_gauges({
+                    "tokens_per_sec_per_chip":
+                        perf["tokens_per_sec_per_chip"],
+                    "mfu": perf["mfu"],
+                })
             with ledger.track("log"):
                 if is_coordinator():
                     step_print(p_step, f"loss: {loss:.4f}")
@@ -646,6 +713,11 @@ def main(
             pending = (global_step, metrics, step_bucket)
             if watchdog is not None:
                 watchdog.beat()
+            if async_checkpoint:
+                # per-step poll of the background commit thread: a fatal
+                # commit error aborts at the NEXT step (with a
+                # ckpt_commit_failed event), not minutes later at flush
+                save_ckpt.check_error()
             # single source of truth for the cadence triggers: sync_now
             # MUST cover every condition that writes a checkpoint below,
             # or a NaN state could enter the rotation unchecked
@@ -693,6 +765,7 @@ def main(
                     {"valid_loss": vloss, **ledger.report()},
                     step=global_step,
                 )
+                publish_prom()  # same cadence as the goodput log line
             if do_sample:
                 with telemetry.span("train/sample", step=global_step), \
                         ledger.track("sample") as tr:
@@ -781,6 +854,7 @@ def main(
                     f"restored checkpoint (state step {restored_step}), "
                     f"data skipped ahead to sequence {seq_cursor}",
                 )
+            reg.inc("anomaly_rollbacks")
             telemetry.get_telemetry().emit({
                 "ev": "anomaly_rollback", "ts": time.time(),
                 "step": step_at, "loss": bad_loss,
@@ -811,11 +885,39 @@ def main(
                 f"{report['wall_s']:.1f}s wall "
                 f"(attributed {report['coverage_pct']:.1f}%)",
             )
+        # per-host goodput (COLLECTIVE — every host reaches this line on
+        # every exit path of the while loop above): each host's ledger
+        # vector is allgathered and the full table lands in every host's
+        # event stream, so one events.jsonl reconstructs the straggler
+        # skew (`telemetry summarize`)
+        host_reports = emit_per_host_goodput(ledger)
+        if is_coordinator() and len(host_reports) > 1:
+            from progen_tpu.telemetry import goodput_skew
+
+            skew = goodput_skew(host_reports)
+            worst = max(
+                (
+                    (row["skew"], name, row["straggler"])
+                    for name, row in skew.items()
+                    if isinstance(row, dict) and name != "goodput_pct"
+                ),
+                default=None,
+            )
+            if worst is not None:
+                step_print(
+                    start_step + steps_done,
+                    f"goodput skew across {skew['hosts']} hosts: worst "
+                    f"bucket '{worst[1]}' +{worst[0]:.2f}s on host "
+                    f"{worst[2]}",
+                )
+        publish_prom()  # final exposition includes the end-of-run books
 
     finally:
         # nested so each cleanup runs even if an earlier one raises
         try:
             chaos.uninstall()  # rules must not leak into a later in-process run
+            if prom_srv is not None:
+                prom_srv.shutdown()
             if watchdog is not None:
                 watchdog.stop()
             # detach the span sink BEFORE the tracker closes its files:

@@ -79,6 +79,30 @@ def _parse(spec: str) -> Dict[str, _Rule]:
     return rules
 
 
+def _record_injection(name: str, rule: "_Rule") -> None:
+    """Every injection leaves a ``chaos`` event + counter — a chaos run
+    whose trace doesn't show where the faults landed can't distinguish
+    'survived the fault' from 'fault never fired'. Lazy imports + broad
+    except: the injector must work (and kill) even with telemetry torn
+    down."""
+    import time
+
+    try:
+        from progen_tpu import telemetry
+        from progen_tpu.telemetry.registry import get_registry
+
+        get_registry().inc("chaos_injections")
+        telemetry.get_telemetry().emit({
+            "ev": "chaos",
+            "ts": time.time(),
+            "site": name,
+            "kind": rule.kind,
+            "hit": rule.hits,
+        })
+    except Exception:
+        pass
+
+
 class ChaosInjector:
     def __init__(self, spec: str, seed: int = 0):
         self.rules = _parse(spec)
@@ -92,14 +116,19 @@ class ChaosInjector:
         rule.hits += 1
         if rule.kind == "prob":
             if self._rng.random() < rule.arg:
+                _record_injection(name, rule)
                 raise ChaosError(f"chaos: injected fault at {name!r}")
         elif rule.kind == "fail":
             if rule.hits == rule.arg:
+                _record_injection(name, rule)
                 raise ChaosError(
                     f"chaos: injected fault at {name!r} (hit {rule.hits})"
                 )
         elif rule.kind == "kill":
             if rule.hits == rule.arg:
+                # the event is written (and flushed, per-line) BEFORE the
+                # kill — the post-mortem trace shows where the run died
+                _record_injection(name, rule)
                 # flush whatever the process has buffered — the whole
                 # point is to die where a preemption would
                 import sys
@@ -119,6 +148,7 @@ class ChaosInjector:
         if rule.hits >= rule.arg:
             return value
         rule.hits += 1
+        _record_injection(name, rule)
         return float("nan") if rule.kind == "nan" else 1e9
 
 

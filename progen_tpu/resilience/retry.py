@@ -36,6 +36,7 @@ import time
 from typing import Callable, Optional
 
 from progen_tpu import telemetry
+from progen_tpu.telemetry.registry import get_registry
 
 
 class TransientError(Exception):
@@ -170,6 +171,7 @@ def retry_call(
                 raise
             delay = policy.delay(attempt, rng)
             retry_counts[label] = retry_counts.get(label, 0) + 1
+            get_registry().inc("retries")
             telemetry.get_telemetry().emit({
                 "ev": "retry",
                 "label": label,

@@ -18,35 +18,55 @@ hung or slow run into a one-line diagnosis (MegaScale NSDI'24, Dapper
   * ``hbm_gauges`` — per-device HBM occupancy from
     ``device.memory_stats()``;
   * ``prometheus_text`` / ``start_prometheus_server`` — text exposition
-    of ``ServingMetrics`` for scraping (file and HTTP).
+    of any ``structured()`` metrics source (serving AND the train-loop
+    ``MetricsRegistry``) for scraping (file and HTTP);
+  * ``MetricsRegistry`` / ``get_registry`` — the process-wide counter/
+    gauge/timing store shared by train, serve, bench, and resilience;
+  * ``trace.build_trace`` / ``export_trace`` — events.jsonl → Chrome
+    Trace Event / Perfetto JSON (the ``telemetry export-trace`` CLI);
+  * ``per_host_reports`` / ``goodput_skew`` / ``emit_per_host_goodput``
+    — MegaScale-style per-host goodput + straggler skew table.
 
 Everything is CPU-testable; nothing here imports jax at module scope.
 """
 
-from progen_tpu.telemetry.goodput import BUCKETS, GoodputLedger
+from progen_tpu.telemetry.goodput import (
+    BUCKETS,
+    GoodputLedger,
+    emit_per_host_goodput,
+    goodput_skew,
+    per_host_reports,
+)
 from progen_tpu.telemetry.hbm import hbm_gauges
 from progen_tpu.telemetry.prometheus import (
     prometheus_text,
     start_prometheus_server,
     write_prometheus,
 )
+from progen_tpu.telemetry.registry import MetricsRegistry, get_registry
 from progen_tpu.telemetry.spans import (
     EventLog,
     Telemetry,
     configure,
     get_telemetry,
+    host_index,
     span,
     step_print,
 )
+from progen_tpu.telemetry.trace import build_trace, export_trace
 from progen_tpu.telemetry.watchdog import StallWatchdog
 
 __all__ = [
     "BUCKETS",
     "GoodputLedger",
+    "per_host_reports",
+    "goodput_skew",
+    "emit_per_host_goodput",
     "EventLog",
     "Telemetry",
     "configure",
     "get_telemetry",
+    "host_index",
     "span",
     "step_print",
     "StallWatchdog",
@@ -54,4 +74,8 @@ __all__ = [
     "prometheus_text",
     "write_prometheus",
     "start_prometheus_server",
+    "MetricsRegistry",
+    "get_registry",
+    "build_trace",
+    "export_trace",
 ]

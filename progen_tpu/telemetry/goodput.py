@@ -79,3 +79,97 @@ class GoodputLedger:
         )
         out["coverage_pct"] = round(100.0 * min(tracked / wall, 1.0), 2)
         return out
+
+
+def _report_from_vector(wall: float, bucket_s: Dict[str, float]) -> dict:
+    """Rebuild a ``report()``-shaped dict from raw (wall, bucket) seconds
+    — used for the *other* hosts' vectors after the allgather."""
+    wall = max(wall, 1e-9)
+    tracked = sum(bucket_s.values())
+    out = {"wall_s": round(wall, 4)}
+    for b in BUCKETS:
+        if bucket_s.get(b, 0.0) > 0.0:
+            out[f"bucket_s/{b}"] = round(bucket_s[b], 4)
+    out["bucket_s/other"] = round(max(wall - tracked, 0.0), 4)
+    out["goodput_pct"] = round(100.0 * bucket_s.get("step", 0.0) / wall, 2)
+    out["coverage_pct"] = round(100.0 * min(tracked / wall, 1.0), 2)
+    return out
+
+
+def per_host_reports(ledger: "GoodputLedger") -> list:
+    """One ``report()`` dict per host, index == ``jax.process_index()``.
+
+    COLLECTIVE under multi-process jax — every process must reach this
+    call (it rides a fixed-width ``process_allgather`` of
+    ``[wall_s, *bucket seconds]``). Single-process (or jax absent /
+    uninitialized) it degrades to ``[ledger.report()]`` with no jax
+    dependency at all, so pure-CPU tests exercise the same code path.
+
+    Custom buckets beyond ``BUCKETS`` stay host-local (the wire format
+    is fixed-width so hosts can't disagree on vector length); their
+    time lands in that host's ``other``, which is still attributed
+    wall clock — the cross-host *skew* story is unaffected.
+    """
+    try:
+        import jax
+
+        if jax.process_count() <= 1:
+            return [ledger.report()]
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        vec = np.asarray(
+            [ledger.wall_s]
+            + [ledger._acc.get(b, 0.0) for b in BUCKETS],
+            dtype=np.float64,
+        )
+        gathered = np.asarray(multihost_utils.process_allgather(vec))
+    except Exception:
+        return [ledger.report()]
+    reports = []
+    for row in gathered:
+        bucket_s = {b: float(row[1 + i]) for i, b in enumerate(BUCKETS)}
+        reports.append(_report_from_vector(float(row[0]), bucket_s))
+    return reports
+
+
+def goodput_skew(host_reports: list) -> dict:
+    """Per-bucket min/max/skew across hosts + the straggler for each.
+
+    ``skew_s`` is max-min bucket seconds; the host holding the max is
+    the straggler (a host stuck in ``data`` or ``checkpoint`` shows up
+    here as its own skew line — MegaScale's straggler table)."""
+    out: dict = {"hosts": len(host_reports)}
+    if not host_reports:
+        return out
+    buckets = sorted(
+        {k for rep in host_reports for k in rep if k.startswith("bucket_s/")}
+    )
+    for key in ("goodput_pct", *buckets):
+        vals = [float(rep.get(key, 0.0)) for rep in host_reports]
+        lo, hi = min(vals), max(vals)
+        name = key.split("/", 1)[-1] if "/" in key else key
+        out[name] = {
+            "min": round(lo, 4),
+            "max": round(hi, 4),
+            "skew": round(hi - lo, 4),
+            "straggler": int(vals.index(hi)),
+        }
+    return out
+
+
+def emit_per_host_goodput(ledger: "GoodputLedger", emit=None) -> list:
+    """Gather per-host reports (collective — see ``per_host_reports``)
+    and emit one ``{"ev": "goodput_host", "host": i, ...}`` record per
+    host through the process telemetry (or an explicit ``emit``). Every
+    host emits the full table into its own event file, so any single
+    host's ``events.jsonl`` is enough to reconstruct the skew."""
+    reports = per_host_reports(ledger)
+    if emit is None:
+        from progen_tpu.telemetry import spans
+
+        emit = spans.get_telemetry().emit
+    now = time.time()
+    for i, rep in enumerate(reports):
+        emit({"ev": "goodput_host", "ts": now, "host": i, **rep})
+    return reports

@@ -20,6 +20,7 @@ tails finally become visible on a dashboard instead of only a mean.
 from __future__ import annotations
 
 import http.server
+import math
 import os
 import re
 import threading
@@ -36,6 +37,13 @@ def _name(prefix: str, raw: str) -> str:
 
 def _fmt(v: float) -> str:
     f = float(v)
+    # Prometheus spellings for the non-finite values a gauge can carry
+    # (an HBM limit on CPU is inf; a poisoned loss is NaN) — the int()
+    # collapse below raises on both, so handle them first
+    if math.isnan(f):
+        return "NaN"
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
     return repr(int(f)) if f == int(f) else repr(f)
 
 

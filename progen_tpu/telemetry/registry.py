@@ -1,0 +1,158 @@
+"""Shared metrics registry: counters, gauges, timing reservoirs.
+
+Before this module, every subsystem kept its own tally — serving had
+``ServingMetrics``, resilience had ``retry_counts``, the train loop's
+anomaly/rollback counts lived only as ``events.jsonl`` lines. A
+Prometheus exposition of the *train* loop needs them in one place, so
+this registry is the process-global home for anything that should end
+up on a dashboard: monotonic counters (retries, rollbacks,
+quarantines, chaos injections, stalls), gauges (goodput %, MFU, HBM
+occupancy), and timing reservoirs (step time quantiles).
+
+``_Timing`` — the bounded-memory Vitter Algorithm-R reservoir that
+serving grew for TTFT tails — lives here now and is re-exported by
+``serving.metrics`` unchanged; the ``telemetry summarize`` CLI reuses
+it for per-span-name p50/p95/p99 over ``events.jsonl``.
+
+Thread-safe by a single lock: the retry path, the watchdog thread, and
+the async-checkpoint error poll all increment concurrently with the
+train loop. ``structured()`` matches the shape
+``telemetry.prometheus.prometheus_text`` consumes, so the registry
+plugs straight into the existing file/HTTP exposition machinery.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+import threading
+from typing import Dict
+
+_RESERVOIR_CAP = 512
+_QUANTILES = ((0.5, "p50_s"), (0.95, "p95_s"), (0.99, "p99_s"))
+
+
+class _Timing:
+    """Running sum/count/min/max plus a fixed-size uniform reservoir
+    (Vitter's Algorithm R) for tail quantiles — latency SLOs live at
+    p99, where a mean is actively misleading. Seeded RNG keeps runs
+    reproducible; memory is bounded at ``_RESERVOIR_CAP`` floats per
+    timing family regardless of observation count."""
+
+    __slots__ = ("sum", "count", "min", "max", "_reservoir", "_rng")
+
+    def __init__(self):
+        self.sum = 0.0
+        self.count = 0
+        self.min = math.inf
+        self.max = 0.0
+        self._reservoir: list = []
+        self._rng = random.Random(0)
+
+    def observe(self, v: float) -> None:
+        self.sum += v
+        self.count += 1
+        self.min = min(self.min, v)
+        self.max = max(self.max, v)
+        if len(self._reservoir) < _RESERVOIR_CAP:
+            self._reservoir.append(v)
+        else:
+            j = self._rng.randrange(self.count)
+            if j < _RESERVOIR_CAP:
+                self._reservoir[j] = v
+
+    def quantile(self, q: float) -> float:
+        if not self._reservoir:
+            return 0.0
+        xs = sorted(self._reservoir)
+        return xs[min(int(q * len(xs)), len(xs) - 1)]
+
+    def stats(self) -> Dict[str, float]:
+        mean = self.sum / self.count if self.count else 0.0
+        out = {
+            "mean_s": mean,
+            "max_s": self.max,
+            "min_s": self.min if self.count else 0.0,
+            "count": float(self.count),
+        }
+        for q, key in _QUANTILES:
+            out[key] = self.quantile(q)
+        return out
+
+
+class MetricsRegistry:
+    """Process-wide counters (monotonic), gauges (last value), and
+    timings (reservoir quantiles), safe under concurrent writers."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters: Dict[str, float] = {}
+        self.gauges: Dict[str, float] = {}
+        self._timings: Dict[str, _Timing] = {}
+
+    def inc(self, name: str, by: float = 1) -> None:
+        """Increment a counter; ``by=0`` declares it (so an exposition
+        shows the zero instead of omitting the family)."""
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + by
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self.gauges[name] = float(value)
+
+    def set_gauges(self, mapping: Dict[str, float]) -> None:
+        with self._lock:
+            for k, v in mapping.items():
+                self.gauges[k] = float(v)
+
+    def observe(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self._timings.setdefault(name, _Timing()).observe(seconds)
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flat dict of everything — tracker-loggable."""
+        with self._lock:
+            out: Dict[str, float] = {
+                k: float(v) for k, v in self.counters.items()
+            }
+            out.update(self.gauges)
+            for name, t in self._timings.items():
+                for stat, v in t.stats().items():
+                    out[f"{name}_{stat}"] = v
+            return out
+
+    def structured(self) -> dict:
+        """Typed view in the shape ``prometheus_text`` consumes."""
+        with self._lock:
+            return {
+                "counters": {
+                    k: float(v) for k, v in self.counters.items()
+                },
+                "gauges": dict(self.gauges),
+                "derived": {},
+                "timings": {
+                    name: {
+                        "sum": t.sum,
+                        "count": t.count,
+                        "quantiles": {
+                            str(q): t.quantile(q) for q, _ in _QUANTILES
+                        },
+                    }
+                    for name, t in self._timings.items()
+                },
+            }
+
+    def reset(self) -> None:
+        """Zero everything — called at CLI entry so one process running
+        several runs (tests via CliRunner) never bleeds counts across."""
+        with self._lock:
+            self.counters.clear()
+            self.gauges.clear()
+            self._timings.clear()
+
+
+_GLOBAL = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return _GLOBAL

@@ -24,11 +24,42 @@ from __future__ import annotations
 import contextlib
 import itertools
 import json
+import sys
 import threading
 import time
 from collections import deque
 from pathlib import Path
 from typing import Callable, Optional
+
+_HOST_INDEX: Optional[int] = None
+
+
+def host_index() -> int:
+    """This process's index in a multi-process run (0 single-process).
+
+    Deliberately lazy and init-free: ``jax.process_index()`` would
+    *initialize* the backend as a side effect, which telemetry must
+    never do (tests assert backends stay uninitialized at import, and a
+    pure-host tool reading a trace has no business dialing a
+    coordinator). So we only ask jax if it is already imported AND its
+    backends are already live, and cache the answer from then on —
+    before that point every record is host 0, which is exactly right
+    for the only process that can exist pre-init."""
+    global _HOST_INDEX
+    if _HOST_INDEX is not None:
+        return _HOST_INDEX
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return 0
+    try:
+        from jax._src import xla_bridge
+
+        if not xla_bridge.backends_are_initialized():
+            return 0
+        _HOST_INDEX = int(jax.process_index())
+    except Exception:
+        return 0
+    return _HOST_INDEX
 
 
 class EventLog:
@@ -95,6 +126,12 @@ class Telemetry:
         sink = self._sink
         if sink is None:
             return
+        # every record carries its host: under multi-process training the
+        # per-host event files merge into one trace, and pid is what the
+        # trace/skew tooling groups on (MegaScale-style straggler
+        # attribution needs the host on *every* retry/anomaly/stall line,
+        # not just spans)
+        record.setdefault("pid", host_index())
         try:
             sink(record)
         except (OSError, ValueError):
@@ -105,7 +142,11 @@ class Telemetry:
     @contextlib.contextmanager
     def span(self, name: str, **attrs):
         sid = next(self._seq)
-        begin = {"ev": "B", "span": name, "id": sid, "ts": time.time()}
+        thread = threading.current_thread()
+        begin = {
+            "ev": "B", "span": name, "id": sid, "ts": time.time(),
+            "tid": thread.ident, "thread": thread.name,
+        }
         if attrs:
             begin.update(attrs)
         with self._lock:
@@ -122,6 +163,7 @@ class Telemetry:
             end = {
                 "ev": "E", "span": name, "id": sid,
                 "ts": time.time(), "dur_s": round(dur, 6),
+                "tid": thread.ident, "thread": thread.name,
             }
             if attrs:
                 end.update(attrs)

@@ -1,0 +1,208 @@
+"""events.jsonl → Chrome Trace Event / Perfetto JSON.
+
+The span stream (``spans.py``) already writes Dapper-style B/E pairs;
+this module is the ``json.dumps`` between that file and a real trace
+viewer (``ui.perfetto.dev`` or ``chrome://tracing``). The mapping is
+direct by design:
+
+  * span ``B``/``E`` records → duration-begin/end slices, ``ts`` in
+    microseconds, ``pid`` = host (``jax.process_index()`` tag stamped
+    by ``Telemetry.emit``), ``tid`` = OS thread — so a two-host run
+    renders as two process tracks and the watchdog/checkpoint threads
+    get their own rows;
+  * one-shot records (``retry``, ``anomaly``, ``stall``, ``chaos``,
+    ``ckpt_commit_failed``, …) → instant events (``ph: "i"``) pinned to
+    their host track;
+  * ``goodput_host`` records and metrics.jsonl rows → counter tracks
+    (``ph: "C"``): ``step_ms``, ``mfu``, ``tokens_per_sec_per_chip``,
+    ``goodput_pct``, stacked ``goodput_bucket_s`` series, and the HBM
+    gauges;
+  * ``M`` metadata names each pid ``host N`` and each tid by its
+    recorded thread name.
+
+The per-host goodput *skew* table rides along as an extra top-level key
+(``progenGoodputSkew``) — trace viewers ignore unknown top-level keys,
+so one file serves both the viewer and the summarize tooling.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Iterable, Iterator, Optional
+
+from progen_tpu.telemetry.goodput import goodput_skew
+
+# record keys that map onto trace-event structure rather than args
+_STRUCTURAL = {"ev", "span", "id", "ts", "pid", "tid", "thread"}
+
+# one-shot telemetry records rendered as instant events on the host track
+INSTANT_EVENTS = (
+    "retry", "anomaly", "anomaly_rollback", "stall", "stall_escalation",
+    "ckpt_quarantine", "ckpt_commit_failed", "chaos", "goodput",
+)
+
+# metrics.jsonl columns that get their own counter track
+_SCALAR_COUNTERS = (
+    "step_ms", "mfu", "tokens_per_sec_per_chip", "goodput_pct",
+)
+
+
+def iter_jsonl(path) -> Iterator[dict]:
+    """Parsed records, one per line; a torn final line (the crash-safety
+    contract allows exactly one) or stray garbage is skipped, not fatal
+    — a trace of a crashed run is the whole point."""
+    with Path(path).open() as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict):
+                yield rec
+
+
+def _us(ts: float) -> float:
+    return float(ts) * 1e6
+
+
+def _args(rec: dict) -> dict:
+    return {k: v for k, v in rec.items() if k not in _STRUCTURAL}
+
+
+def _counter(name: str, ts: float, pid: int, series: dict) -> dict:
+    return {
+        "ph": "C", "name": name, "ts": _us(ts), "pid": pid, "tid": 0,
+        "args": series,
+    }
+
+
+def _goodput_counters(rec: dict, ts: float, pid: int) -> list:
+    out = []
+    if "goodput_pct" in rec:
+        out.append(_counter(
+            "goodput_pct", ts, pid, {"goodput_pct": rec["goodput_pct"]}
+        ))
+    buckets = {
+        k.split("/", 1)[1]: v
+        for k, v in rec.items() if k.startswith("bucket_s/")
+    }
+    if buckets:
+        out.append(_counter("goodput_bucket_s", ts, pid, buckets))
+    return out
+
+
+def build_trace(
+    events: Iterable[dict], metrics: Iterable[dict] = ()
+) -> dict:
+    """Assemble the Trace Event JSON object from parsed events.jsonl
+    records (and optionally metrics.jsonl rows for the perf counter
+    tracks). Returns the dict — callers ``json.dump`` it."""
+    trace_events: list = []
+    meta: list = []
+    seen_pids: set = set()
+    seen_tids: set = set()
+    host_reports: dict = {}
+
+    def _note_pid(pid: int) -> None:
+        if pid not in seen_pids:
+            seen_pids.add(pid)
+            meta.append({
+                "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+                "args": {"name": f"host {pid}"},
+            })
+
+    for rec in events:
+        ev = rec.get("ev")
+        ts = rec.get("ts")
+        if ev is None or ts is None:
+            continue
+        pid = int(rec.get("pid", 0))
+        if ev in ("B", "E"):
+            tid = int(rec.get("tid", 0) or 0)
+            _note_pid(pid)
+            thread = rec.get("thread")
+            if thread and (pid, tid) not in seen_tids:
+                seen_tids.add((pid, tid))
+                meta.append({
+                    "ph": "M", "name": "thread_name", "pid": pid,
+                    "tid": tid, "args": {"name": str(thread)},
+                })
+            trace_events.append({
+                "ph": ev, "name": str(rec.get("span", "?")),
+                "cat": "span", "ts": _us(ts), "pid": pid, "tid": tid,
+                "args": _args(rec),
+            })
+        elif ev == "goodput_host":
+            host = int(rec.get("host", pid))
+            _note_pid(host)
+            host_reports[host] = {
+                k: v for k, v in rec.items()
+                if k not in ("ev", "ts", "host", "pid")
+            }
+            trace_events.extend(_goodput_counters(rec, ts, host))
+        elif ev in INSTANT_EVENTS:
+            _note_pid(pid)
+            trace_events.append({
+                "ph": "i", "name": str(ev), "cat": "event",
+                "ts": _us(ts), "pid": pid, "tid": 0, "s": "p",
+                "args": _args(rec),
+            })
+
+    for rec in metrics:
+        ts = rec.get("_time")
+        if ts is None:
+            continue
+        pid = int(rec.get("pid", 0))
+        _note_pid(pid)
+        for key in _SCALAR_COUNTERS:
+            if key in rec:
+                trace_events.append(
+                    _counter(key, ts, pid, {key: rec[key]})
+                )
+        buckets = {
+            k.split("/", 1)[1]: v
+            for k, v in rec.items() if k.startswith("bucket_s/")
+        }
+        if buckets:
+            trace_events.append(
+                _counter("goodput_bucket_s", ts, pid, buckets)
+            )
+        hbm = {
+            k.split("/", 1)[1]: v
+            for k, v in rec.items() if k.startswith("hbm/")
+        }
+        if hbm:
+            trace_events.append(_counter("hbm", ts, pid, hbm))
+
+    # stable sort: records at the same ts keep file order, so a B always
+    # precedes its zero-duration E and viewers never see a negative nest
+    trace_events.sort(key=lambda e: e["ts"])
+    out = {
+        "traceEvents": meta + trace_events,
+        "displayTimeUnit": "ms",
+    }
+    if host_reports:
+        reports = [host_reports[h] for h in sorted(host_reports)]
+        out["progenGoodputSkew"] = goodput_skew(reports)
+    return out
+
+
+def export_trace(
+    events_path, out_path, metrics_path: Optional[str] = None
+) -> dict:
+    """File-to-file convenience used by the CLI: read events.jsonl (and
+    metrics.jsonl when present), write Trace Event JSON, return the
+    trace dict."""
+    metrics: list = []
+    if metrics_path is not None and Path(metrics_path).exists():
+        metrics = list(iter_jsonl(metrics_path))
+    trace = build_trace(iter_jsonl(events_path), metrics)
+    out_path = Path(out_path)
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    with out_path.open("w") as f:
+        json.dump(trace, f)
+    return trace

@@ -40,6 +40,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from progen_tpu.telemetry.registry import get_registry
 from progen_tpu.telemetry.spans import Telemetry, get_telemetry
 
 
@@ -143,6 +144,7 @@ class StallWatchdog:
                     pass
 
     def _fire(self, stalled_s: float) -> None:
+        get_registry().inc("stalls")
         out = self._file if self._file is not None else sys.stderr
         tel = (
             self._telemetry
@@ -194,6 +196,7 @@ class StallWatchdog:
         """Nth consecutive report for one stall: snapshot per-device
         allocator state + the open spans into the telemetry sink, so the
         record survives the kill that usually follows."""
+        get_registry().inc("stall_escalations")
         out = self._file if self._file is not None else sys.stderr
         tel = (
             self._telemetry

@@ -20,6 +20,7 @@ Only process 0 should construct a real tracker (partition.is_coordinator);
 from __future__ import annotations
 
 import json
+import threading
 import time
 import uuid
 from pathlib import Path
@@ -75,24 +76,33 @@ class JsonlTracker(NoopTracker):
         self.path.mkdir(parents=True, exist_ok=True)
         self._metrics = (self.path / "metrics.jsonl").open("a")
         self._events = None  # opened on first span; most runs have none
+        # the watchdog thread, async-checkpoint paths, and retry hooks
+        # all emit through log_event concurrently with the train loop's
+        # log(); the lock makes every write+flush one critical section
+        # so JSONL lines can never tear or interleave
+        self._lock = threading.Lock()
 
     def log(self, metrics: dict, step: Optional[int] = None) -> None:
         rec = {"_time": time.time(), **metrics}
         if step is not None:
             rec["_step"] = step
-        self._metrics.write(json.dumps(rec) + "\n")
-        self._metrics.flush()
+        with self._lock:
+            if self._metrics.closed:
+                raise ValueError("tracker is finished")
+            self._metrics.write(json.dumps(rec) + "\n")
+            self._metrics.flush()
 
     def log_event(self, record: dict) -> None:
         """Span/watchdog records -> events.jsonl beside metrics.jsonl,
         same crash-safety discipline (flush per line). Raises ValueError
         after ``finish()`` — telemetry sinks treat that as detach."""
-        if self._events is None:
-            if self._metrics.closed:
-                raise ValueError("tracker is finished")
-            self._events = (self.path / "events.jsonl").open("a")
-        self._events.write(json.dumps(record) + "\n")
-        self._events.flush()
+        with self._lock:
+            if self._events is None:
+                if self._metrics.closed:
+                    raise ValueError("tracker is finished")
+                self._events = (self.path / "events.jsonl").open("a")
+            self._events.write(json.dumps(record) + "\n")
+            self._events.flush()
 
     def log_html(self, name: str, html: str, step: Optional[int] = None) -> None:
         suffix = f"_{step}" if step is not None else ""
@@ -102,9 +112,10 @@ class JsonlTracker(NoopTracker):
         (self.path / "config.json").write_text(json.dumps(config, default=str))
 
     def finish(self) -> None:
-        self._metrics.close()
-        if self._events is not None:
-            self._events.close()
+        with self._lock:
+            self._metrics.close()
+            if self._events is not None:
+                self._events.close()
 
 
 class WandbTracker(NoopTracker):  # exercised via a mock module in-suite

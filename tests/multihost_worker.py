@@ -47,6 +47,20 @@ from progen_tpu.training.step import (
 assert jax.process_count() == 2
 assert len(jax.devices()) == 8
 
+# --- per-host telemetry: each process writes its own event file (two
+# writers on one file would be two EventLogs, not one locked one); every
+# record is pid-tagged via Telemetry.emit, and the end-of-run per-host
+# goodput allgather means either file alone carries the full skew table
+from pathlib import Path
+
+from progen_tpu import telemetry
+from progen_tpu.telemetry import GoodputLedger, emit_per_host_goodput
+
+telemetry.configure(
+    path=Path(ckpt_dir).parent / f"events_p{process_id}.jsonl"
+)
+ledger = GoodputLedger()
+
 CFG = ProGenConfig(
     num_tokens=32, dim=16, seq_len=16, depth=2, window_size=8,
     global_mlp_depth=1, heads=2, dim_head=8, ff_mult=2, dtype="float32",
@@ -70,12 +84,15 @@ _, get_last, save = get_checkpoint_fns(ckpt_dir)
 
 with mesh:
     for i in range(2):
-        local = next(ds)  # (4, 17) — this process's rows of the global batch
-        batch = put_batch(local[None], mesh, accum_axis=True)
-        state, metrics = step(state, batch)
+        with ledger.track("data"):
+            local = next(ds)  # this process's rows of the global batch
+            batch = put_batch(local[None], mesh, accum_axis=True)
+        with ledger.track("step"):
+            state, metrics = step(state, batch)
         print(f"LOSS {i} {float(metrics['loss']):.6f}", flush=True)
 
-    save(Package(16, state, CFG.to_dict(), "mh-run"))
+    with ledger.track("checkpoint"):
+        save(Package(16, state, CFG.to_dict(), "mh-run"))
 
     # sharded restore on the same mesh; continue training one more step
     _, abstract = abstract_train_state(model, optimizer, CFG.seq_len)
@@ -163,5 +180,14 @@ with mesh_pipe:
         state_p, put_batch(both[None], mesh_pipe, accum_axis=True)
     )
     print(f"LOSS_PIPE {float(metrics_p['loss']):.6f}", flush=True)
+
+# --- per-host goodput: process 1 books a deterministic extra data-wait so
+# the parent can assert the skew table fingers it as the straggler; the
+# emit is COLLECTIVE (fixed-width allgather) and both processes reach it
+if process_id == 1:
+    ledger.account("data", 0.5)
+reports = emit_per_host_goodput(ledger)
+assert len(reports) == 2, reports
+telemetry.configure()  # detach before exit: no spans to a closing file
 
 print("WORKER_OK", flush=True)

@@ -157,3 +157,30 @@ def test_two_process_training_matches_single(tmp_path):
     step_p = jax.jit(make_train_step(model_pipe, optimizer))
     _, metrics_p = step_p(state_p, first_batch)
     np.testing.assert_allclose(p0, float(metrics_p["loss"]), rtol=1e-5)
+
+    # --- per-host goodput: each worker emitted the allgathered 2-host
+    # table into its own event file, so EITHER file alone reconstructs
+    # the cross-host skew; worker 1 booked +0.5s of data wait, and the
+    # summarize report must finger it as the data straggler
+    import json
+
+    from click.testing import CliRunner
+
+    from progen_tpu.cli.telemetry import main as telemetry_cli
+
+    ev = tmp_path / "events_p0.jsonl"
+    assert ev.exists(), "worker 0 left no event stream"
+    hosts = {
+        rec["host"]
+        for rec in map(json.loads, ev.read_text().splitlines())
+        if rec.get("ev") == "goodput_host"
+    }
+    assert hosts == {0, 1}
+    res = CliRunner().invoke(telemetry_cli, ["summarize", str(ev)])
+    assert res.exit_code == 0, res.output
+    assert "straggler table" in res.output
+    straggler_lines = [
+        ln for ln in res.output.splitlines()
+        if ln.startswith("data") and "straggler host 1" in ln
+    ]
+    assert straggler_lines, res.output

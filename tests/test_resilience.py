@@ -475,3 +475,72 @@ class TestTrainChaos:
         )
         assert res.exit_code == 0, res.output
         assert retry_counts.get("ckpt/io/meta_write", 0) > before
+
+
+# -------------------------------------------- async commit error surfacing
+
+
+class TestAsyncCommitErrorPoll:
+    """save.check_error(): the per-step poll that surfaces a fatal
+    background-commit failure at the NEXT step instead of the next flush."""
+
+    class _FailingCkptr:
+        def __init__(self, exc):
+            self._exc = exc
+            self.closed = False
+
+        def check_for_errors(self):
+            raise self._exc
+
+        def close(self):
+            self.closed = True
+
+    def test_commit_error_raises_emits_and_retires(self, tmp_path):
+        from progen_tpu.checkpoint import get_checkpoint_fns
+        from progen_tpu.telemetry.registry import get_registry
+        from progen_tpu.telemetry.spans import configure
+
+        records = []
+        configure(sink=records.append)
+        try:
+            _, _, save = get_checkpoint_fns(str(tmp_path), async_save=True)
+            bad = self._FailingCkptr(RuntimeError("disk on fire"))
+            save._async["ckptr"] = bad
+            save._async["pending"] = ("doomed", {"meta": 1})
+            before = get_registry().snapshot().get(
+                "ckpt_commit_failures", 0
+            )
+            with pytest.raises(RuntimeError, match="disk on fire"):
+                save.check_error()
+        finally:
+            configure()
+        evs = [r for r in records if r.get("ev") == "ckpt_commit_failed"]
+        assert len(evs) == 1
+        assert "RuntimeError: disk on fire" in evs[0]["error"]
+        after = get_registry().snapshot().get("ckpt_commit_failures", 0)
+        assert after == before + 1
+        # a failed commit must never publish meta.json: the pending
+        # finalizer is dropped and the checkpointer retired + closed
+        assert "pending" not in save._async
+        assert "ckptr" not in save._async
+        assert bad.closed
+        # the retired checkpointer makes the finally-path close a no-op
+        save.close()
+
+    def test_noop_without_inflight_checkpointer(self, tmp_path):
+        from progen_tpu.checkpoint import get_checkpoint_fns
+
+        _, _, save_sync = get_checkpoint_fns(str(tmp_path / "s"))
+        save_sync.check_error()  # sync mode: nothing to poll
+        _, _, save_async = get_checkpoint_fns(
+            str(tmp_path / "a"), async_save=True
+        )
+        save_async.check_error()  # async mode, nothing in flight yet
+
+    def test_noop_when_orbax_lacks_poll_api(self, tmp_path):
+        from progen_tpu.checkpoint import get_checkpoint_fns
+
+        _, _, save = get_checkpoint_fns(str(tmp_path), async_save=True)
+        save._async["ckptr"] = object()  # no check_for_errors attr
+        save.check_error()  # flush-time surfacing still applies
+        save._async.pop("ckptr")

@@ -1,0 +1,252 @@
+"""Trace export + run analysis: events.jsonl → Perfetto trace-event
+JSON (B/E pairing, monotonic ts, per-host tracks, counter tracks), the
+per-host goodput skew aggregation, and the `telemetry` CLI (export-trace
+/ summarize)."""
+
+import json
+
+import pytest
+from click.testing import CliRunner
+
+from progen_tpu.cli.telemetry import main as telemetry_cli
+from progen_tpu.telemetry import (
+    EventLog,
+    GoodputLedger,
+    Telemetry,
+    build_trace,
+    emit_per_host_goodput,
+    goodput_skew,
+    per_host_reports,
+)
+from progen_tpu.telemetry.trace import iter_jsonl
+
+
+# ------------------------------------------------------- trace building
+
+
+def _sample_events():
+    return [
+        {"ev": "B", "span": "train/compile", "id": 0, "ts": 10.0,
+         "pid": 0, "tid": 11, "thread": "MainThread"},
+        {"ev": "E", "span": "train/compile", "id": 0, "ts": 12.0,
+         "dur_s": 2.0, "pid": 0, "tid": 11, "thread": "MainThread"},
+        {"ev": "B", "span": "ckpt/save", "id": 1, "ts": 12.5,
+         "pid": 1, "tid": 22, "thread": "MainThread", "step": 3},
+        {"ev": "retry", "label": "ckpt/io/meta_write", "ts": 12.6,
+         "pid": 1},
+        {"ev": "E", "span": "ckpt/save", "id": 1, "ts": 13.0,
+         "dur_s": 0.5, "pid": 1, "tid": 22, "thread": "MainThread",
+         "step": 3},
+        {"ev": "goodput_host", "ts": 14.0, "host": 0, "wall_s": 4.0,
+         "bucket_s/step": 3.0, "bucket_s/other": 1.0,
+         "goodput_pct": 75.0, "coverage_pct": 75.0},
+        {"ev": "goodput_host", "ts": 14.0, "host": 1, "wall_s": 4.0,
+         "bucket_s/step": 2.0, "bucket_s/data": 1.0,
+         "bucket_s/other": 1.0, "goodput_pct": 50.0,
+         "coverage_pct": 75.0},
+    ]
+
+
+def test_build_trace_slices_pair_and_nest_per_track():
+    trace = build_trace(_sample_events())
+    evs = trace["traceEvents"]
+    # B/E pairing: per (pid, tid) track the begin/end events form a
+    # valid stack — every E closes the innermost open B of that name
+    stacks = {}
+    for e in (x for x in evs if x["ph"] in ("B", "E")):
+        key = (e["pid"], e["tid"])
+        if e["ph"] == "B":
+            stacks.setdefault(key, []).append(e["name"])
+        else:
+            assert stacks[key], f"E without open B on {key}"
+            assert stacks[key].pop() == e["name"]
+    assert all(not s for s in stacks.values())
+    # span attrs ride as args, structural keys do not
+    ckpt_b = next(
+        e for e in evs if e["ph"] == "B" and e["name"] == "ckpt/save"
+    )
+    assert ckpt_b["args"] == {"step": 3}
+    assert ckpt_b["cat"] == "span"
+
+
+def test_build_trace_ts_monotonic_and_microseconds():
+    trace = build_trace(_sample_events())
+    timed = [e for e in trace["traceEvents"] if e["ph"] != "M"]
+    ts = [e["ts"] for e in timed]
+    assert ts == sorted(ts)
+    assert min(ts) == pytest.approx(10.0 * 1e6)  # seconds → microseconds
+
+
+def test_build_trace_metadata_names_hosts_and_threads():
+    trace = build_trace(_sample_events())
+    meta = [e for e in trace["traceEvents"] if e["ph"] == "M"]
+    proc_names = {
+        e["pid"]: e["args"]["name"]
+        for e in meta if e["name"] == "process_name"
+    }
+    assert proc_names == {0: "host 0", 1: "host 1"}
+    thread_names = {
+        (e["pid"], e["tid"])
+        for e in meta if e["name"] == "thread_name"
+    }
+    assert (0, 11) in thread_names and (1, 22) in thread_names
+
+
+def test_build_trace_instants_and_goodput_counters():
+    trace = build_trace(_sample_events())
+    evs = trace["traceEvents"]
+    retry = next(e for e in evs if e["ph"] == "i")
+    assert retry["name"] == "retry" and retry["pid"] == 1
+    assert retry["s"] == "p"
+    counters = [e for e in evs if e["ph"] == "C"]
+    # per-host goodput counter tracks: pid = host
+    pct = {e["pid"]: e for e in counters if e["name"] == "goodput_pct"}
+    assert pct[0]["args"] == {"goodput_pct": 75.0}
+    assert pct[1]["args"] == {"goodput_pct": 50.0}
+    buckets = {
+        e["pid"]: e["args"]
+        for e in counters if e["name"] == "goodput_bucket_s"
+    }
+    assert buckets[1] == {"step": 2.0, "data": 1.0, "other": 1.0}
+    # the skew table rides as an extra top-level key (viewers ignore it)
+    skew = trace["progenGoodputSkew"]
+    assert skew["hosts"] == 2
+    assert skew["data"]["straggler"] == 1
+
+
+def test_build_trace_metrics_counter_tracks():
+    metrics = [
+        {"_time": 20.0, "_step": 1, "step_ms": 120.0, "mfu": 0.41,
+         "tokens_per_sec_per_chip": 999.0, "hbm/in_use_gb": 3.5,
+         "hbm/peak_gb": 4.0},
+        {"_time": 21.0, "_step": 2, "goodput_pct": 88.0,
+         "bucket_s/step": 8.8},
+        {"no_time": True},  # ignored: no _time stamp
+    ]
+    trace = build_trace([], metrics)
+    counters = {
+        (e["name"], e["ts"]): e["args"]
+        for e in trace["traceEvents"] if e["ph"] == "C"
+    }
+    assert counters[("step_ms", 20.0 * 1e6)] == {"step_ms": 120.0}
+    assert counters[("mfu", 20.0 * 1e6)] == {"mfu": 0.41}
+    assert counters[("hbm", 20.0 * 1e6)] == {
+        "in_use_gb": 3.5, "peak_gb": 4.0
+    }
+    assert counters[("goodput_bucket_s", 21.0 * 1e6)] == {"step": 8.8}
+
+
+def test_iter_jsonl_skips_torn_and_garbage_lines(tmp_path):
+    p = tmp_path / "ev.jsonl"
+    p.write_text(
+        '{"ev": "B", "span": "a", "id": 0, "ts": 1.0}\n'
+        "not json at all\n"
+        "[1, 2, 3]\n"
+        '{"ev": "E", "span": "a", "id": 0, "ts": 2.0, "dur_s": 1.0}\n'
+        '{"ev": "E", "span": "b", "tr'  # torn final line (SIGKILL)
+    )
+    recs = list(iter_jsonl(p))
+    assert [r["ev"] for r in recs] == ["B", "E"]
+
+
+# --------------------------------------------------- per-host goodput
+
+
+def test_per_host_reports_single_process_matches_report():
+    t = {"now": 0.0}
+    ledger = GoodputLedger(clock=lambda: t["now"])
+    with ledger.track("step"):
+        t["now"] += 3.0
+    t["now"] += 1.0
+    assert per_host_reports(ledger) == [ledger.report()]
+
+
+def test_goodput_skew_fingers_straggler():
+    fast = {"wall_s": 10.0, "bucket_s/step": 8.0, "bucket_s/data": 1.0,
+            "bucket_s/other": 1.0, "goodput_pct": 80.0}
+    slow = {"wall_s": 10.0, "bucket_s/step": 6.0, "bucket_s/data": 3.0,
+            "bucket_s/other": 1.0, "goodput_pct": 60.0}
+    skew = goodput_skew([fast, slow])
+    assert skew["hosts"] == 2
+    assert skew["data"] == {
+        "min": 1.0, "max": 3.0, "skew": 2.0, "straggler": 1
+    }
+    assert skew["goodput_pct"]["straggler"] == 0  # max pct is host 0
+
+
+def test_emit_per_host_goodput_writes_event(tmp_path):
+    t = {"now": 0.0}
+    ledger = GoodputLedger(clock=lambda: t["now"])
+    with ledger.track("step"):
+        t["now"] += 2.0
+    out = []
+    reports = emit_per_host_goodput(ledger, emit=out.append)
+    assert len(reports) == len(out) == 1
+    assert out[0]["ev"] == "goodput_host" and out[0]["host"] == 0
+    assert out[0]["goodput_pct"] == reports[0]["goodput_pct"]
+
+
+# ------------------------------------------------------------- the CLI
+
+
+@pytest.fixture()
+def run_dir(tmp_path):
+    """A fake run directory: events.jsonl from real spans + injected
+    per-host goodput, metrics.jsonl beside it."""
+    log = EventLog(tmp_path / "events.jsonl")
+    tel = Telemetry(sink=log.emit)
+    with tel.span("train/compile"):
+        pass
+    for i in range(3):
+        with tel.span("train/step", step=i):
+            pass
+    tel.emit({"ev": "retry", "label": "data/read", "ts": 1.0})
+    for rec in _sample_events()[-2:]:  # the two goodput_host records
+        tel.emit(dict(rec))
+    log.close()
+    with (tmp_path / "metrics.jsonl").open("w") as f:
+        f.write(json.dumps(
+            {"_time": 5.0, "_step": 1, "step_ms": 100.0, "mfu": 0.3}
+        ) + "\n")
+    return tmp_path
+
+
+def test_export_trace_cli_roundtrip(run_dir):
+    res = CliRunner().invoke(
+        telemetry_cli, ["export-trace", str(run_dir / "events.jsonl")]
+    )
+    assert res.exit_code == 0, res.output
+    trace = json.loads((run_dir / "trace.json").read_text())
+    phs = {e["ph"] for e in trace["traceEvents"]}
+    assert {"B", "E", "C", "i", "M"} <= phs
+    # sibling metrics.jsonl picked up by default → step_ms counter track
+    assert any(
+        e["ph"] == "C" and e["name"] == "step_ms"
+        for e in trace["traceEvents"]
+    )
+    assert trace["progenGoodputSkew"]["hosts"] == 2
+
+
+def test_export_trace_cli_explicit_out(run_dir, tmp_path):
+    out = tmp_path / "sub" / "t.json"
+    res = CliRunner().invoke(
+        telemetry_cli,
+        ["export-trace", str(run_dir / "events.jsonl"),
+         "--out", str(out)],
+    )
+    assert res.exit_code == 0, res.output
+    assert json.loads(out.read_text())["traceEvents"]
+
+
+def test_summarize_cli_report(run_dir):
+    res = CliRunner().invoke(
+        telemetry_cli, ["summarize", str(run_dir / "events.jsonl")]
+    )
+    assert res.exit_code == 0, res.output
+    out = res.output
+    assert "goodput (per host)" in out
+    assert "straggler table" in out
+    assert "straggler host 1" in out  # host 1 booked the data skew
+    assert "span latency" in out
+    assert "train/step" in out
+    assert "retry" in out  # event counts section
