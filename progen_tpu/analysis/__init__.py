@@ -1,0 +1,54 @@
+"""progen-tpu-lint: JAX/TPU-aware static analysis for this stack.
+
+The defect classes that hurt a TPU training/serving stack most —
+silent recompilation, host-device syncs in hot loops, RNG key reuse,
+donated-buffer use-after-free, trace-time-only side effects, unpaired
+telemetry spans — are invisible to pytest on CPU and only surface as
+goodput loss or wrong samples on a real pod. This package moves their
+detection left of runtime: an AST linter with one rule per defect
+class, run over the whole package in CI (``progen-tpu-lint
+progen_tpu/``), failing the build on any non-baselined finding.
+
+Rules (see each module's docstring for the full rationale):
+
+  PGL001  host-device sync inside a jitted/scanned region
+  PGL002  RNG key reuse without split/fold_in
+  PGL003  donated argument referenced after the donating call
+  PGL004  recompilation hazards (varying/unhashable static args,
+          jit-of-fresh-lambda, branch on traced values)
+  PGL005  side effects inside traced code (run once, at trace time)
+  PGL006  telemetry hygiene (literal span names, B/E via the context
+          manager, Prometheus-legal metric names)
+
+Suppress a single accepted finding inline with
+``# progen: ignore[PGL005]``; grandfathered findings live in
+``lint_baseline.json`` with a reason string each (analysis/runner.py).
+"""
+
+from progen_tpu.analysis.core import Finding, ModuleContext, Rule
+from progen_tpu.analysis.runner import (
+    RULE_DOCS,
+    RULES,
+    BaselineError,
+    discover_files,
+    lint_file,
+    lint_paths,
+    load_baseline,
+    report_json,
+)
+from progen_tpu.analysis.traced import TracedIndex
+
+__all__ = [
+    "Finding",
+    "ModuleContext",
+    "Rule",
+    "RULES",
+    "RULE_DOCS",
+    "BaselineError",
+    "TracedIndex",
+    "discover_files",
+    "lint_file",
+    "lint_paths",
+    "load_baseline",
+    "report_json",
+]

@@ -1,0 +1,151 @@
+"""PGL003 — donated buffer referenced after the donating call.
+
+``donate_argnums``/``donate_argnames`` hands the argument's device
+buffer to XLA for reuse as output storage: after the call the old array
+object is DELETED. Reading it again raises
+``RuntimeError: Array has been deleted`` on a real backend — but only
+where donation actually engages (CPU jit often keeps the buffer alive),
+so CPU pytest passes while the pod run dies at step 2. The train step
+donates its TrainState for exactly this in-place-update reason
+(training/step.py), which is what makes the pattern worth a rule.
+
+Module-local by design: the rule knows a callable donates when the
+module itself created it — ``@partial(jax.jit, donate_argnums=...)`` on
+a def, or ``name = jax.jit(fn, donate_argnums=...)`` — and then flags
+any read of a donated bare-name argument after the call, until the name
+is rebound. Loop bodies run twice, so a donating call in a loop whose
+argument is not rebound each iteration reports too.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Set, Tuple
+
+from progen_tpu.analysis.core import Rule, call_name
+from progen_tpu.analysis.traced import donated_call_args
+
+_FUNCTION_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+class DonationRule(Rule):
+    id = "PGL003"
+    severity = "error"
+    doc = ("argument donated via donate_argnums/donate_argnames is "
+           "referenced after the call — its buffer may be deleted")
+
+    def run(self):
+        if self.ctx.traced_index is None or \
+                not self.ctx.traced_index.jit_registry:
+            return self.findings
+        for node in ast.walk(self.ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._analyze_function(node)
+        return self.findings
+
+    def _analyze_function(self, fn) -> None:
+        # name -> line of the donating call that consumed it
+        donated: Dict[str, int] = {}
+        reported: Set[Tuple[int, str]] = set()
+        self._exec_block(fn.body, donated, reported)
+
+    def _exec_block(self, stmts, donated, reported) -> None:
+        for stmt in stmts:
+            self._exec_stmt(stmt, donated, reported)
+
+    def _exec_stmt(self, stmt, donated, reported) -> None:
+        if isinstance(stmt, _FUNCTION_NODES[:2]):
+            self._exec_block(stmt.body, dict(donated), reported)
+            return
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            if stmt.value is not None:
+                self._eval_expr(stmt.value, donated, reported)
+            targets = (
+                stmt.targets if isinstance(stmt, ast.Assign)
+                else [stmt.target]
+            )
+            for t in targets:
+                self._clear_target(t, donated)
+            return
+        if isinstance(stmt, ast.Expr):
+            self._eval_expr(stmt.value, donated, reported)
+            return
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self._eval_expr(stmt.value, donated, reported)
+            return
+        if isinstance(stmt, ast.If):
+            self._eval_expr(stmt.test, donated, reported)
+            d1, d2 = dict(donated), dict(donated)
+            self._exec_block(stmt.body, d1, reported)
+            self._exec_block(stmt.orelse, d2, reported)
+            # donated after the if only when donated on BOTH paths
+            donated.clear()
+            donated.update({
+                k: d1[k] for k in set(d1) & set(d2)
+            })
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._eval_expr(stmt.iter, donated, reported)
+            self._clear_target(stmt.target, donated)
+            for _ in range(2):  # donation from iteration N read at N+1
+                self._exec_block(stmt.body, donated, reported)
+            self._exec_block(stmt.orelse, donated, reported)
+            return
+        if isinstance(stmt, ast.While):
+            for _ in range(2):
+                self._eval_expr(stmt.test, donated, reported)
+                self._exec_block(stmt.body, donated, reported)
+            self._exec_block(stmt.orelse, donated, reported)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._eval_expr(item.context_expr, donated, reported)
+            self._exec_block(stmt.body, donated, reported)
+            return
+        if isinstance(stmt, ast.Try):
+            self._exec_block(stmt.body, donated, reported)
+            for h in stmt.handlers:
+                self._exec_block(h.body, dict(donated), reported)
+            self._exec_block(stmt.orelse, donated, reported)
+            self._exec_block(stmt.finalbody, donated, reported)
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._eval_expr(child, donated, reported)
+
+    def _clear_target(self, target, donated) -> None:
+        if isinstance(target, ast.Name):
+            donated.pop(target.id, None)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._clear_target(elt, donated)
+
+    def _eval_expr(self, expr, donated, reported) -> None:
+        registry = self.ctx.traced_index.jit_registry
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Name) and isinstance(
+                node.ctx, ast.Load
+            ) and node.id in donated:
+                key = (node.lineno, node.id)
+                if key not in reported:
+                    reported.add(key)
+                    self.report(
+                        node,
+                        f"'{node.id}' was donated at line "
+                        f"{donated[node.id]} and is referenced afterwards "
+                        f"— the donated buffer may already be deleted on "
+                        f"device",
+                    )
+        # mark donations AFTER scanning reads: the donating call's own
+        # argument read is legal
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = call_name(node)
+            info = registry.get(cname) if cname else None
+            if info is None:
+                continue
+            for _pname, arg in donated_call_args(info, node):
+                if isinstance(arg, ast.Name):
+                    donated[arg.id] = node.lineno

@@ -1,0 +1,67 @@
+"""PGL005 — side effects inside traced code.
+
+``print``, tracker/telemetry emission, and file IO inside a
+jit/scan/shard_map body run ONCE, at trace time — then never again, no
+matter how many steps execute. The symptom is a metric that freezes at
+its step-0 value or a log line that vanishes after the first call;
+nothing crashes, so only a rule catches it. ``jax.debug.print`` /
+``jax.debug.callback`` / ``io_callback`` / ``pl.debug_print`` are the
+sanctioned effectful escape hatches and are exempt.
+
+Trace-time-only effects that are INTENTIONAL (e.g. reading a kernel
+policy table while tracing a shard_map body) get an inline
+``# progen: ignore[PGL005]`` with the justification right there.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from progen_tpu.analysis.core import Rule, call_name, name_suffix_in
+
+_EFFECT_CALLS = ("print", "open", "input", "step_print")
+# attribute-call tails that emit/persist: tracker + telemetry + file IO
+_EFFECT_METHODS = (
+    "log", "log_event", "log_html", "emit",
+    "write", "writelines", "write_text", "write_bytes",
+    "info", "warning", "error", "debug", "exception",
+)
+_ALLOWED = (
+    "jax.debug.print", "debug.print", "pl.debug_print",
+    "jax.debug.callback", "debug.callback",
+    "jax.experimental.io_callback", "io_callback",
+    "host_callback.call",
+)
+
+
+class TracedEffectsRule(Rule):
+    id = "PGL005"
+    severity = "error"
+    doc = ("side effect (print/tracker.log/telemetry emit/file IO) "
+           "inside traced code runs once at trace time, then never again")
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self.generic_visit(node)
+        if not self.ctx.in_traced_region(node):
+            return
+        cname = call_name(node)
+        if name_suffix_in(cname, _ALLOWED):
+            return
+        if cname in _EFFECT_CALLS:
+            self.report(
+                node,
+                f"{cname}(...) inside a traced region executes once at "
+                f"trace time only; use jax.debug.print/io_callback or "
+                f"move it outside the trace",
+            )
+            return
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _EFFECT_METHODS
+        ):
+            self.report(
+                node,
+                f".{node.func.attr}(...) inside a traced region executes "
+                f"once at trace time only — telemetry/log records from "
+                f"here will silently stop after the first step",
+            )

@@ -1,0 +1,92 @@
+"""PGL001 — host-device sync inside a traced region.
+
+``float(x)``, ``x.item()``, ``bool(x)``, ``np.asarray(x)``,
+``jax.device_get(x)`` on a traced value force the device to finish
+everything in flight and ship the result to the host. In host code that
+is the intended fence (the train loop's deferred-metrics flush does it
+on purpose); inside a jitted/scanned/vmapped body it either raises a
+``TracerConversionError`` at trace time or — worse, via ``np.asarray``
+on a committed array in a region that jit later swallows — silently
+serializes the hot loop. pytest on CPU never notices; the goodput
+ledger does.
+
+The rule fires only inside traced regions (see analysis/traced.py).
+Conversions of trace-time-constant expressions (literals, ``.shape``
+/``.ndim``/``len()`` arithmetic) are exempt — those are Python ints at
+trace time, not tracer reads.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from progen_tpu.analysis.core import Rule, call_name, name_suffix_in
+
+# callables that read a device value back to the host
+_SYNC_BUILTINS = ("float", "int", "bool")
+_SYNC_CALLS = (
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "onp.asarray", "onp.array",
+    "jax.device_get", "device_get",
+)
+_SYNC_METHODS = ("item", "tolist", "__array__")
+
+# attribute tails whose read is trace-time Python, not a device sync
+_STATIC_ATTRS = ("shape", "ndim", "size", "dtype")
+
+
+def _is_static_expr(node: ast.AST) -> bool:
+    """Trace-time-constant expressions: converting these costs nothing."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+        return True
+    if isinstance(node, ast.Subscript):
+        return _is_static_expr(node.value)
+    if isinstance(node, ast.BinOp):
+        return _is_static_expr(node.left) and _is_static_expr(node.right)
+    if isinstance(node, ast.UnaryOp):
+        return _is_static_expr(node.operand)
+    if isinstance(node, ast.Call):
+        return name_suffix_in(call_name(node), ("len",))
+    return False
+
+
+class HostSyncRule(Rule):
+    id = "PGL001"
+    severity = "error"
+    doc = ("host-device sync (float()/.item()/np.asarray/device_get/"
+           "bool()) inside a jitted, scanned, or vmapped region")
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self.generic_visit(node)
+        if not self.ctx.in_traced_region(node):
+            return
+        cname = call_name(node)
+        if cname in _SYNC_BUILTINS:
+            if node.args and not _is_static_expr(node.args[0]):
+                self.report(
+                    node,
+                    f"{cname}() on a traced value forces a host sync "
+                    f"inside a traced region; keep it a jnp scalar or "
+                    f"move the read outside the trace",
+                )
+            return
+        if name_suffix_in(cname, _SYNC_CALLS):
+            self.report(
+                node,
+                f"{cname}(...) pulls a device array to the host inside a "
+                f"traced region; use jnp.asarray / restructure so the "
+                f"transfer happens outside the trace",
+            )
+            return
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _SYNC_METHODS
+            and not node.args
+        ):
+            self.report(
+                node,
+                f".{node.func.attr}() reads a traced value back to the "
+                f"host inside a traced region",
+            )

@@ -1,0 +1,174 @@
+"""PGL004 — recompilation hazards.
+
+XLA compiles once per (shapes, dtypes, static argument VALUES, function
+identity). Three syntactic patterns defeat that cache and each has
+burned a real JAX codebase:
+
+  * an f-string / str.format / list / dict / set flowing into a static
+    argument: every distinct value (or every call, for unhashables —
+    those raise) is a fresh compile of the whole step;
+  * ``jax.jit(lambda ...: ...)`` inside a function or loop: the lambda
+    is a NEW function object per execution, so the jit cache never
+    hits;
+  * ``jax.jit(f)(x)`` immediately invoked inside a loop: same cache
+    miss, one compile per iteration.
+  * Python ``if``/``while`` directly on a traced parameter: under jit
+    this raises TracerBoolConversionError; "fixed" by making the value
+    static, it becomes one compile per distinct value — flag the branch
+    itself so neither outcome ships.
+
+Static-argument call-site checking resolves through the module's jit
+wrapper registry (analysis/traced.py), so positional arguments map to
+``static_argnames`` through the wrapped def's real signature.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from progen_tpu.analysis.core import Rule, call_name, name_suffix_in
+from progen_tpu.analysis.traced import static_call_args
+
+_UNHASHABLE_NODES = (
+    ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp,
+    ast.GeneratorExp,
+)
+_FUNCTION_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+_JIT_NAMES = ("jax.jit", "jit", "pjit")
+
+
+def _is_varying_str(node: ast.AST) -> bool:
+    if isinstance(node, ast.JoinedStr):
+        return True
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "format"
+    )
+
+
+class RecompileRule(Rule):
+    id = "PGL004"
+    severity = "error"
+    doc = ("recompilation hazard: unhashable/varying static args, "
+           "jit-of-fresh-lambda, jit-in-loop, branch on traced value")
+
+    def _in_loop(self, node: ast.AST) -> bool:
+        return any(
+            isinstance(a, (ast.For, ast.While, ast.AsyncFor))
+            for a in self.ctx.ancestors(node)
+        )
+
+    def _in_function(self, node: ast.AST) -> bool:
+        return self.ctx.enclosing_function(node) is not None
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self.generic_visit(node)
+        cname = call_name(node)
+        # (a) static args at call sites of registered jit wrappers
+        registry = getattr(self.ctx.traced_index, "jit_registry", {})
+        info = registry.get(cname) if cname else None
+        if info is not None and info.static_names:
+            for pname, arg in static_call_args(info, node):
+                if _is_varying_str(arg):
+                    self.report(
+                        arg,
+                        f"f-string/format() value flowing into static "
+                        f"argument '{pname}' of '{info.name}' — every "
+                        f"distinct string is a full recompile",
+                    )
+                elif isinstance(arg, _UNHASHABLE_NODES):
+                    self.report(
+                        arg,
+                        f"non-hashable {type(arg).__name__} passed as "
+                        f"static argument '{pname}' of '{info.name}' — "
+                        f"jit static args must be hashable (use a tuple)",
+                    )
+        # (b)/(c) jit of a fresh lambda / jit-in-loop immediate invocation
+        if name_suffix_in(cname, _JIT_NAMES) and node.args:
+            if isinstance(node.args[0], ast.Lambda) and (
+                self._in_function(node) or self._in_loop(node)
+            ):
+                self.report(
+                    node,
+                    "jax.jit(<lambda>) inside a function/loop creates a "
+                    "new cache entry per execution — hoist the jitted "
+                    "callable to module scope or cache the wrapper",
+                )
+            parent = self.ctx.parent(node)
+            if (
+                isinstance(parent, ast.Call)
+                and parent.func is node
+                and self._in_loop(node)
+            ):
+                self.report(
+                    node,
+                    "jax.jit(f)(...) immediately invoked inside a loop "
+                    "recompiles every iteration — build the jitted "
+                    "function once outside the loop",
+                )
+
+    # (d) Python branch on a traced parameter
+    def _check_branch(self, node, test: ast.AST) -> None:
+        idx = self.ctx.traced_index
+        if idx is None:
+            return
+        traced_def = idx.enclosing_traced_def(node)
+        if traced_def is None:
+            return
+        a = traced_def.args
+        params = {
+            p.arg for p in a.posonlyargs + a.args + a.kwonlyargs
+        } - {"self", "cls"}
+        if isinstance(traced_def, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            info = idx.jit_registry.get(traced_def.name)
+            if info is not None:
+                params -= info.static_names
+        name = self._bare_traced_name(test, params)
+        if name:
+            self.report(
+                test,
+                f"Python branch on traced value '{name}' — under jit "
+                f"this raises at trace time, and making it static means "
+                f"one recompile per distinct value; use jnp.where/"
+                f"lax.cond or hoist the decision out of the trace",
+            )
+
+    def _bare_traced_name(self, test: ast.AST, params) -> str:
+        """A param name used as a bare truth value in ``test`` ('' if
+        none): Name, ``not Name``, comparisons of Names, bool ops of
+        those. Names under attributes/subscripts/calls (``x.shape[0]``)
+        do not count — those are trace-time Python."""
+        if isinstance(test, ast.Name):
+            return test.id if test.id in params else ""
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return self._bare_traced_name(test.operand, params)
+        if isinstance(test, ast.Compare):
+            # `x is None` / `x is not None` are trace-time identity
+            # checks on default sentinels, not value reads
+            if all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+            ):
+                return ""
+            for side in [test.left] + list(test.comparators):
+                if isinstance(side, ast.Name) and side.id in params:
+                    return side.id
+            return ""
+        if isinstance(test, ast.BoolOp):
+            for v in test.values:
+                name = self._bare_traced_name(v, params)
+                if name:
+                    return name
+        return ""
+
+    def visit_If(self, node: ast.If) -> None:
+        self.generic_visit(node)
+        self._check_branch(node, node.test)
+
+    def visit_While(self, node: ast.While) -> None:
+        self.generic_visit(node)
+        self._check_branch(node, node.test)
+
+    def visit_IfExp(self, node: ast.IfExp) -> None:
+        self.generic_visit(node)
+        self._check_branch(node, node.test)

@@ -1,0 +1,265 @@
+"""PGL002 — RNG key used twice without an interposing split/fold_in.
+
+jax PRNG keys are VALUES, not stateful generators: passing the same key
+to two samplers draws the same bits twice. In a sampler that means
+correlated noise (every slot of a batch decoding the same Gumbel
+stream); in an init it means tied weights. Nothing errors — outputs are
+just silently wrong, and only statistically so.
+
+The rule runs a small per-function dataflow over assignments:
+
+  * a name becomes a FRESH key when assigned from
+    ``jax.random.PRNGKey/key/split/fold_in`` (or when it is a function
+    parameter named like a key: ``key``, ``rng``, ``*_key`` ...);
+  * passing a key to any call CONSUMES it (``split(key)`` included —
+    splitting the same key twice yields identical children), EXCEPT
+    ``fold_in``, which derives data-dependent children and is the
+    sanctioned way to reuse one parent key;
+  * consuming an already-consumed key reports.
+
+``if``/``else`` branches analyze independently and merge
+conservatively (a name consumed on only one path is not reported
+later). Loop bodies run TWICE, so a key consumed inside a loop without
+an in-loop re-derivation reports on the simulated second iteration —
+the classic ``for i: noise = normal(key, ...)`` bug.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Optional, Set, Tuple
+
+from progen_tpu.analysis.core import Rule, call_name, name_suffix_in
+
+FRESH = "fresh"
+CONSUMED = "consumed"
+MAYBE = "maybe"  # divergent merge: not reported on later use
+
+_KEY_PRODUCERS = (
+    "random.PRNGKey", "PRNGKey", "random.key",
+    "random.split", "random.fold_in", "fold_in",
+    "random.wrap_key_data",
+)
+_NON_CONSUMING = (
+    "random.fold_in", "fold_in", "random.key_data",
+    # abstract evaluation: traces shapes/dtypes only, draws no bits
+    "eval_shape", "jax.eval_shape",
+)
+_KEY_PARAM_RE = re.compile(r"(^|_)(key|keys|rng|rngs|prng)$")
+# a key-named param annotated (or defaulted) as a plain host type is not a
+# PRNG key — e.g. the TFRecord feature name `key: bytes = b"seq"`
+_NON_KEY_ANNOTATIONS = ("str", "bytes", "int", "float", "bool")
+
+_FUNCTION_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _is_key_producer(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and name_suffix_in(
+        call_name(node), _KEY_PRODUCERS
+    )
+
+
+def _key_params(args: ast.arguments) -> Set[str]:
+    """Param names that look like PRNG keys, minus any whose annotation
+    or default pins them to a plain host type."""
+    params = list(args.posonlyargs) + list(args.args)
+    defaults: Dict[str, ast.expr] = {}
+    for p, d in zip(reversed(params), reversed(args.defaults)):
+        defaults[p.arg] = d
+    for p, d in zip(args.kwonlyargs, args.kw_defaults):
+        if d is not None:
+            defaults[p.arg] = d
+    out: Set[str] = set()
+    for p in params + list(args.kwonlyargs):
+        if not _KEY_PARAM_RE.search(p.arg):
+            continue
+        ann = p.annotation
+        if isinstance(ann, ast.Name) and ann.id in _NON_KEY_ANNOTATIONS:
+            continue
+        d = defaults.get(p.arg)
+        if isinstance(d, ast.Constant) and isinstance(
+            d.value, (str, bytes, int, float, bool)
+        ):
+            continue
+        out.add(p.arg)
+    return out
+
+
+def _terminates(stmts) -> bool:
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break)
+    )
+
+
+class RngReuseRule(Rule):
+    id = "PGL002"
+    severity = "error"
+    doc = ("RNG key consumed twice without an interposing "
+           "jax.random.split/fold_in — identical random bits drawn")
+
+    def run(self):
+        for node in ast.walk(self.ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._analyze_function(node)
+        return self.findings
+
+    # ----- function-level dataflow ---------------------------------------
+
+    def _analyze_function(self, fn) -> None:
+        state: Dict[str, str] = {}
+        for name in _key_params(fn.args):
+            state[name] = FRESH
+        reported: Set[Tuple[int, str]] = set()
+        self._exec_block(fn.body, state, reported)
+
+    def _exec_block(self, stmts, state: Dict[str, str],
+                    reported: Set[Tuple[int, str]]) -> None:
+        for stmt in stmts:
+            self._exec_stmt(stmt, state, reported)
+
+    def _exec_stmt(self, stmt, state, reported) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: closure reads see the current key states, but
+            # its params shadow and its consumptions stay local
+            inner = dict(state)
+            a = stmt.args
+            keyish = _key_params(a)
+            for p in a.posonlyargs + a.args + a.kwonlyargs:
+                if p.arg in keyish:
+                    inner[p.arg] = FRESH
+                else:
+                    inner.pop(p.arg, None)
+            self._exec_block(stmt.body, inner, reported)
+            state.pop(stmt.name, None)
+            return
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = stmt.value
+            if value is not None:
+                self._eval_expr(value, state, reported)
+            targets = (
+                stmt.targets if isinstance(stmt, ast.Assign)
+                else [stmt.target]
+            )
+            produced = _is_key_producer(value) if value is not None else False
+            alias_state: Optional[str] = None
+            if isinstance(value, ast.Name) and value.id in state:
+                alias_state = state[value.id]
+            for t in targets:
+                self._bind_target(t, produced, alias_state, state)
+            return
+        if isinstance(stmt, ast.Expr):
+            self._eval_expr(stmt.value, state, reported)
+            return
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self._eval_expr(stmt.value, state, reported)
+            return
+        if isinstance(stmt, ast.If):
+            self._eval_expr(stmt.test, state, reported)
+            s_body, s_else = dict(state), dict(state)
+            self._exec_block(stmt.body, s_body, reported)
+            self._exec_block(stmt.orelse, s_else, reported)
+            # a branch ending in return/raise doesn't fall through: only
+            # the surviving branch's state reaches the code after the if
+            body_exits = _terminates(stmt.body)
+            else_exits = _terminates(stmt.orelse)
+            if body_exits and not else_exits:
+                state.clear()
+                state.update(s_else)
+            elif else_exits and not body_exits:
+                state.clear()
+                state.update(s_body)
+            else:
+                self._merge(state, s_body, s_else)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._eval_expr(stmt.iter, state, reported)
+            self._bind_target(stmt.target, False, None, state)
+            for _ in range(2):  # second pass = simulated next iteration
+                self._exec_block(stmt.body, state, reported)
+            self._exec_block(stmt.orelse, state, reported)
+            return
+        if isinstance(stmt, ast.While):
+            for _ in range(2):
+                self._eval_expr(stmt.test, state, reported)
+                self._exec_block(stmt.body, state, reported)
+            self._exec_block(stmt.orelse, state, reported)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._eval_expr(item.context_expr, state, reported)
+                if item.optional_vars is not None:
+                    self._bind_target(item.optional_vars, False, None, state)
+            self._exec_block(stmt.body, state, reported)
+            return
+        if isinstance(stmt, ast.Try):
+            self._exec_block(stmt.body, state, reported)
+            for h in stmt.handlers:
+                self._exec_block(h.body, dict(state), reported)
+            self._exec_block(stmt.orelse, state, reported)
+            self._exec_block(stmt.finalbody, state, reported)
+            return
+        # anything else: scan contained expressions conservatively
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._eval_expr(child, state, reported)
+
+    def _bind_target(self, target, produced: bool,
+                     alias_state: Optional[str], state) -> None:
+        if isinstance(target, ast.Name):
+            if produced:
+                state[target.id] = FRESH
+            elif alias_state is not None:
+                state[target.id] = alias_state
+            else:
+                state.pop(target.id, None)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind_target(elt, produced, alias_state, state)
+
+    def _merge(self, state, s1, s2) -> None:
+        for name in set(s1) | set(s2):
+            a, b = s1.get(name), s2.get(name)
+            if a == b and a is not None:
+                state[name] = a
+            elif a is None and b is None:
+                state.pop(name, None)
+            else:
+                state[name] = MAYBE
+
+    # ----- expression consumption ----------------------------------------
+
+    def _eval_expr(self, expr, state, reported) -> None:
+        if isinstance(expr, ast.Lambda):
+            inner = dict(state)
+            a = expr.args
+            for p in a.posonlyargs + a.args + a.kwonlyargs:
+                inner.pop(p.arg, None)
+            self._eval_expr(expr.body, inner, reported)
+            return
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            consuming = not name_suffix_in(call_name(node), _NON_CONSUMING)
+            for arg in list(node.args) + [
+                kw.value for kw in node.keywords
+            ]:
+                if not isinstance(arg, ast.Name):
+                    continue
+                st = state.get(arg.id)
+                if st is None or not consuming:
+                    continue
+                if st == CONSUMED:
+                    key = (arg.lineno, arg.id)
+                    if key not in reported:
+                        reported.add(key)
+                        self.report(
+                            arg,
+                            f"RNG key '{arg.id}' is consumed again "
+                            f"without an interposing jax.random.split/"
+                            f"fold_in — the same random bits are drawn "
+                            f"twice",
+                        )
+                else:
+                    state[arg.id] = CONSUMED

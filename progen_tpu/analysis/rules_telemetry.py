@@ -1,0 +1,129 @@
+"""PGL006 — telemetry hygiene.
+
+Span hygiene only pays off when it is enforced (Dapper's lesson): a
+span name that varies per call explodes the name cardinality the
+summarize/trace tooling groups on; a hand-rolled ``{"ev": "B"}`` record
+that never gets its ``E`` (an exception, an early return) corrupts the
+open-span accounting the stall watchdog reports from. And a metric name
+that fails the Prometheus grammar gets silently mangled by
+``telemetry/prometheus.py``'s ``_name()`` at render time — the
+dashboard query then matches nothing. Three checks:
+
+  * ``span(...)`` / ``.span(...)`` names must be string literals
+    (a bare name is allowed only when the enclosing function forwards
+    its own parameter — the wrapper pattern ``spans.span`` itself uses);
+  * raw ``"ev": "B"``/``"ev": "E"`` records must not be emitted outside
+    ``telemetry/spans.py`` — B/E pairing goes through the ``span()``
+    context manager, whose ``finally`` guarantees the E;
+  * string-literal metric names fed to the registry (``.inc``,
+    ``.set_gauge``, ``.observe``, ``.set_gauges`` keys) and literal
+    ``"ev"`` values must already satisfy the Prometheus name rules the
+    renderer enforces (``[a-zA-Z_:][a-zA-Z0-9_:]*``).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from progen_tpu.analysis.core import Rule, call_name
+
+_PROM_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_REGISTRY_METHODS = ("inc", "set_gauge", "observe")
+
+
+def _str_const(node) -> bool:
+    return isinstance(node, ast.Constant) and isinstance(node.value, str)
+
+
+class TelemetryHygieneRule(Rule):
+    id = "PGL006"
+    severity = "error"
+    doc = ("span/metric naming hygiene: literal span names, B/E only "
+           "via the span() context manager, Prometheus-legal metric "
+           "names")
+
+    def _in_spans_module(self) -> bool:
+        return self.ctx.path.replace("\\", "/").endswith(
+            "telemetry/spans.py"
+        )
+
+    def _enclosing_params(self, node) -> set:
+        fn = self.ctx.enclosing_function(node)
+        if fn is None:
+            return set()
+        a = fn.args
+        return {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self.generic_visit(node)
+        cname = call_name(node)
+        tail = cname.rsplit(".", 1)[-1] if cname else ""
+        if tail == "span" and node.args:
+            self._check_span_name(node)
+        if tail in ("emit", "log_event"):
+            for arg in node.args:
+                if isinstance(arg, ast.Dict):
+                    self._check_event_dict(arg)
+        if tail in _REGISTRY_METHODS and node.args:
+            if _str_const(node.args[0]):
+                self._check_prom_name(node.args[0], node.args[0].value)
+        if tail == "set_gauges" and node.args:
+            if isinstance(node.args[0], ast.Dict):
+                for k in node.args[0].keys:
+                    if _str_const(k):
+                        self._check_prom_name(k, k.value)
+
+    def _check_span_name(self, node: ast.Call) -> None:
+        name_arg = node.args[0]
+        if _str_const(name_arg):
+            return
+        if isinstance(name_arg, ast.Name) and \
+                name_arg.id in self._enclosing_params(node):
+            return  # forwarding wrapper: span(name) inside def f(name)
+        kind = (
+            "an f-string" if isinstance(name_arg, ast.JoinedStr)
+            else "a non-literal expression"
+        )
+        self.report(
+            name_arg,
+            f"span name is {kind} — span names must be string literals "
+            f"so the trace/summarize tooling groups on a bounded, "
+            f"greppable set; put varying data in span attrs instead",
+        )
+
+    def _check_event_dict(self, d: ast.Dict) -> None:
+        for k, v in zip(d.keys, d.values):
+            if not (_str_const(k) and k.value == "ev"):
+                continue
+            if not _str_const(v):
+                self.report(
+                    v,
+                    "event 'ev' tag must be a string literal so event "
+                    "streams stay greppable",
+                )
+                continue
+            if v.value in ("B", "E") and not self._in_spans_module():
+                self.report(
+                    v,
+                    "raw B/E span record emitted directly — use the "
+                    "span() context manager, whose finally-block "
+                    "guarantees the matching E even on exceptions",
+                )
+            elif not _PROM_NAME_RE.match(v.value):
+                self.report(
+                    v,
+                    f"event tag '{v.value}' is not a clean identifier "
+                    f"([a-zA-Z_][a-zA-Z0-9_]*) — downstream tooling "
+                    f"keys on it",
+                )
+
+    def _check_prom_name(self, node, name: str) -> None:
+        if not _PROM_NAME_RE.match(name):
+            self.report(
+                node,
+                f"metric name '{name}' fails the Prometheus name rules "
+                f"(telemetry/prometheus.py would mangle it at render "
+                f"time and dashboard queries would miss): use "
+                f"[a-zA-Z_:][a-zA-Z0-9_:]*",
+            )

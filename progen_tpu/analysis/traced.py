@@ -1,0 +1,322 @@
+"""Traced-region and jit-wrapper indexing.
+
+Almost every rule here needs the answer to one question before it can
+say anything useful: *does this code run under a jax trace?* A
+``float()`` in host code is a log line; the same call inside a jitted
+step is a device sync in the hot loop. This module computes that answer
+syntactically, once per module:
+
+  * functions whose DECORATORS trace them (``@jax.jit``,
+    ``@functools.partial(jax.jit, ...)``, ``@jax.vmap``, grad, remat...);
+  * functions/lambdas PASSED to tracing callables (``lax.scan`` bodies,
+    ``lax.fori_loop`` bodies, ``shard_map`` shard functions, ``vmap``ed
+    callables) — resolved by name against defs in enclosing scopes;
+  * everything lexically inside either of the above (a nested ``def``
+    in a jitted function executes at trace time).
+
+It also builds the module's JIT WRAPPER REGISTRY: for every function
+jitted with ``static_argnums``/``static_argnames`` or
+``donate_argnums``/``donate_argnames`` — whether via decorator or via
+``name = jax.jit(fn, ...)`` — the registry records which parameters are
+static and which are donated, mapped through the wrapped def's
+signature so positional call sites resolve to names. PGL003 (donated
+use-after-call) and PGL004 (recompilation hazards) are lookups against
+it. Resolution is module-local by design: a linter that guessed across
+imports would guess wrong quietly.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from progen_tpu.analysis.core import (
+    ModuleContext,
+    call_name,
+    dotted_name,
+    name_suffix_in,
+)
+
+# decorators that make the decorated function's body traced
+TRACING_DECORATORS = (
+    "jax.jit", "jit", "pjit", "jax.pjit",
+    "jax.vmap", "vmap", "jax.pmap", "pmap",
+    "jax.grad", "grad", "jax.value_and_grad", "value_and_grad",
+    "jax.checkpoint", "jax.remat", "nn.remat", "nn.jit",
+    "jax.named_call",
+)
+
+# callables whose function-valued arguments are traced bodies
+TRACING_CALLERS = (
+    "lax.fori_loop", "fori_loop",
+    "lax.scan",
+    "lax.while_loop", "while_loop",
+    "lax.cond", "lax.switch", "lax.map", "lax.associative_scan",
+    "jax.jit", "jit", "jax.vmap", "vmap", "jax.pmap", "pmap",
+    "jax.grad", "grad", "jax.value_and_grad", "value_and_grad",
+    "jax.checkpoint", "jax.remat",
+    "shard_map",
+)
+
+_FUNCTION_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _is_partial_of(call: ast.Call, suffixes) -> bool:
+    """``functools.partial(jax.jit, ...)`` / ``partial(jit, ...)``."""
+    if not name_suffix_in(call_name(call), ("partial",)):
+        return False
+    return bool(call.args) and name_suffix_in(
+        dotted_name(call.args[0]), suffixes
+    )
+
+
+def _decorator_traces(dec: ast.AST) -> bool:
+    if isinstance(dec, ast.Call):
+        if name_suffix_in(call_name(dec), TRACING_DECORATORS):
+            return True
+        return _is_partial_of(dec, TRACING_DECORATORS)
+    return name_suffix_in(dotted_name(dec), TRACING_DECORATORS)
+
+
+def _int_literals(node: ast.AST) -> Optional[List[int]]:
+    """Ints from a literal int / tuple / list, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if not (
+                isinstance(elt, ast.Constant) and isinstance(elt.value, int)
+            ):
+                return None
+            out.append(elt.value)
+        return out
+    return None
+
+
+def _str_literals(node: ast.AST) -> Optional[List[str]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if not (
+                isinstance(elt, ast.Constant) and isinstance(elt.value, str)
+            ):
+                return None
+            out.append(elt.value)
+        return out
+    return None
+
+
+@dataclass
+class JitInfo:
+    """One jit-wrapped callable the module can call by name."""
+
+    name: str  # the callable name call sites use
+    params: List[str] = field(default_factory=list)  # wrapped signature
+    static_names: Set[str] = field(default_factory=set)
+    donated_names: Set[str] = field(default_factory=set)
+    def_node: Optional[ast.AST] = None
+
+    def param_for_pos(self, pos: int) -> Optional[str]:
+        return self.params[pos] if 0 <= pos < len(self.params) else None
+
+
+def _params_of(fn: ast.AST) -> List[str]:
+    if not isinstance(fn, _FUNCTION_NODES):
+        return []
+    a = fn.args
+    return [p.arg for p in (a.posonlyargs + a.args)]
+
+
+def _jit_kw_sets(call: ast.Call, params: List[str]):
+    """(static_names, donated_names) from a jit(...) call's keywords,
+    positions resolved through ``params``."""
+    static: Set[str] = set()
+    donated: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg in ("static_argnums", "donate_argnums"):
+            nums = _int_literals(kw.value) or []
+            target = static if kw.arg == "static_argnums" else donated
+            for i in nums:
+                name = params[i] if 0 <= i < len(params) else None
+                if name:
+                    target.add(name)
+        elif kw.arg in ("static_argnames", "donate_argnames"):
+            names = _str_literals(kw.value) or []
+            target = static if kw.arg == "static_argnames" else donated
+            target.update(names)
+    return static, donated
+
+
+class TracedIndex:
+    """Per-module map of traced function nodes + jit wrapper registry.
+
+    Construction attaches the index to the context
+    (``ctx.traced_index``) so ``ctx.in_traced_region`` works.
+    """
+
+    def __init__(self, ctx: ModuleContext):
+        self.ctx = ctx
+        self.traced: Set[ast.AST] = set()
+        self.jit_registry: Dict[str, JitInfo] = {}
+        # (scope node or None for module) -> {name: def node}
+        self._defs: Dict[Optional[ast.AST], Dict[str, ast.AST]] = {}
+        self._collect_defs()
+        self._mark_decorated()
+        self._mark_bodies_and_registry()
+        ctx.traced_index = self
+
+    # ----- def collection -------------------------------------------------
+
+    def _collect_defs(self) -> None:
+        for node in ast.walk(self.ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scope = self.ctx.enclosing_function(node)
+                self._defs.setdefault(scope, {})[node.name] = node
+
+    def resolve_def(self, name: str,
+                    from_node: ast.AST) -> Optional[ast.AST]:
+        """Innermost def named ``name`` visible from ``from_node``."""
+        scope = self.ctx.enclosing_function(from_node)
+        while True:
+            found = self._defs.get(scope, {}).get(name)
+            if found is not None:
+                return found
+            if scope is None:
+                return None
+            scope = self.ctx.enclosing_function(scope)
+
+    # ----- traced marking -------------------------------------------------
+
+    def _mark_decorated(self) -> None:
+        for node in ast.walk(self.ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(_decorator_traces(d) for d in node.decorator_list):
+                    self.traced.add(node)
+
+    def _mark_arg(self, arg: ast.AST, call: ast.Call) -> None:
+        if isinstance(arg, ast.Lambda):
+            self.traced.add(arg)
+        elif isinstance(arg, ast.Name):
+            fn = self.resolve_def(arg.id, call)
+            if fn is not None:
+                self.traced.add(fn)
+
+    def _mark_bodies_and_registry(self) -> None:
+        for node in ast.walk(self.ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = call_name(node)
+            if name_suffix_in(cname, TRACING_CALLERS) or _is_partial_of(
+                node, TRACING_DECORATORS
+            ):
+                args = node.args[1:] if _is_partial_of(
+                    node, TRACING_DECORATORS
+                ) else node.args
+                for arg in args:
+                    self._mark_arg(arg, node)
+            self._maybe_register_jit(node)
+        # decorator-carried static/donate info for decorated defs
+        for node in ast.walk(self.ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for dec in node.decorator_list:
+                if not isinstance(dec, ast.Call):
+                    continue
+                is_jit = name_suffix_in(
+                    call_name(dec), ("jax.jit", "jit", "pjit")
+                ) or _is_partial_of(dec, ("jax.jit", "jit", "pjit"))
+                if not is_jit:
+                    continue
+                params = _params_of(node)
+                static, donated = _jit_kw_sets(dec, params)
+                if static or donated:
+                    self.jit_registry[node.name] = JitInfo(
+                        name=node.name,
+                        params=params,
+                        static_names=static,
+                        donated_names=donated,
+                        def_node=node,
+                    )
+
+    def _maybe_register_jit(self, call: ast.Call) -> None:
+        """``name = jax.jit(fn, static_argnums=..., donate_argnums=...)``
+        -> registry entry under ``name`` (the assignment target)."""
+        if not name_suffix_in(call_name(call), ("jax.jit", "jit", "pjit")):
+            return
+        if not (call.args and call.keywords):
+            return
+        fn_arg = call.args[0]
+        params: List[str] = []
+        fn_node = None
+        if isinstance(fn_arg, ast.Name):
+            fn_node = self.resolve_def(fn_arg.id, call)
+            params = _params_of(fn_node) if fn_node is not None else []
+        elif isinstance(fn_arg, ast.Lambda):
+            fn_node = fn_arg
+            params = _params_of(fn_arg)
+        static, donated = _jit_kw_sets(call, params)
+        if not (static or donated):
+            return
+        parent = self.ctx.parent(call)
+        if isinstance(parent, ast.Assign) and len(parent.targets) == 1 and \
+                isinstance(parent.targets[0], ast.Name):
+            self.jit_registry[parent.targets[0].id] = JitInfo(
+                name=parent.targets[0].id,
+                params=params,
+                static_names=static,
+                donated_names=donated,
+                def_node=fn_node,
+            )
+
+    # ----- queries --------------------------------------------------------
+
+    def in_traced_region(self, node: ast.AST) -> bool:
+        if node in self.traced:
+            return True
+        return any(
+            anc in self.traced
+            for anc in self.ctx.ancestors(node)
+            if isinstance(anc, _FUNCTION_NODES)
+        )
+
+    def enclosing_traced_def(self, node: ast.AST) -> Optional[ast.AST]:
+        if node in self.traced:
+            return node
+        for anc in self.ctx.ancestors(node):
+            if anc in self.traced and isinstance(anc, _FUNCTION_NODES):
+                return anc
+        return None
+
+
+def static_call_args(
+    info: JitInfo, call: ast.Call
+) -> List[Tuple[str, ast.AST]]:
+    """(static param name, argument expression) pairs for one call of a
+    registered jit wrapper."""
+    out: List[Tuple[str, ast.AST]] = []
+    for i, arg in enumerate(call.args):
+        name = info.param_for_pos(i)
+        if name and name in info.static_names:
+            out.append((name, arg))
+    for kw in call.keywords:
+        if kw.arg and kw.arg in info.static_names:
+            out.append((kw.arg, kw.value))
+    return out
+
+
+def donated_call_args(
+    info: JitInfo, call: ast.Call
+) -> List[Tuple[str, ast.AST]]:
+    out: List[Tuple[str, ast.AST]] = []
+    for i, arg in enumerate(call.args):
+        name = info.param_for_pos(i)
+        if name and name in info.donated_names:
+            out.append((name, arg))
+    for kw in call.keywords:
+        if kw.arg and kw.arg in info.donated_names:
+            out.append((kw.arg, kw.value))
+    return out

@@ -1,0 +1,125 @@
+"""progen-tpu-lint CLI: the commit-time gate over the PGL rules.
+
+Pure-host tooling — no jax import, so it runs in any CI step (and in a
+pre-commit hook) in milliseconds. Exit code contract:
+
+  0  no findings beyond the baseline
+  1  at least one NEW finding (printed, and written to --json if given)
+  2  usage/baseline errors (malformed baseline entries fail loudly —
+     a silent baseline is how gates rot)
+
+Run: progen-tpu-lint progen_tpu/ [--baseline lint_baseline.json]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+import click
+
+from progen_tpu.analysis import (
+    RULE_DOCS,
+    BaselineError,
+    lint_paths,
+    load_baseline,
+    report_json,
+)
+
+
+@click.command()
+@click.argument("paths", nargs=-1, type=click.Path(exists=True))
+@click.option(
+    "--baseline",
+    "baseline_path",
+    type=click.Path(dir_okay=False),
+    default=None,
+    help="baseline JSON of grandfathered findings (default: "
+    "lint_baseline.json next to the first PATH or in the cwd, when "
+    "present)",
+)
+@click.option(
+    "--no-baseline",
+    is_flag=True,
+    default=False,
+    help="ignore any baseline file: report every finding as new",
+)
+@click.option(
+    "--json",
+    "json_out",
+    type=click.Path(dir_okay=False),
+    default=None,
+    help="write the machine-readable findings report here (CI uploads "
+    "this as an artifact on failure)",
+)
+@click.option(
+    "--list-rules", is_flag=True, default=False,
+    help="print the rule table and exit",
+)
+def main(paths, baseline_path, no_baseline, json_out, list_rules):
+    """Lint PATHS (files or directories) with the PGL rule set."""
+    if list_rules:
+        for rule_id in sorted(RULE_DOCS):
+            click.echo(f"{rule_id}  {RULE_DOCS[rule_id]}")
+        return
+    if not paths:
+        raise click.UsageError("no paths given (try: progen-tpu-lint .)")
+
+    baseline = []
+    if not no_baseline:
+        candidates = (
+            [Path(baseline_path)]
+            if baseline_path
+            else [
+                Path(paths[0]).resolve().parent / "lint_baseline.json",
+                Path.cwd() / "lint_baseline.json",
+            ]
+        )
+        for cand in candidates:
+            if cand.is_file():
+                try:
+                    baseline = load_baseline(cand)
+                except (BaselineError, json.JSONDecodeError) as e:
+                    click.echo(f"error: bad baseline: {e}", err=True)
+                    sys.exit(2)
+                break
+        else:
+            if baseline_path:
+                click.echo(
+                    f"error: baseline not found: {baseline_path}", err=True
+                )
+                sys.exit(2)
+
+    new, baselined = lint_paths(paths, baseline=baseline)
+
+    if json_out:
+        Path(json_out).parent.mkdir(parents=True, exist_ok=True)
+        Path(json_out).write_text(
+            json.dumps(report_json(new, baselined), indent=2) + "\n"
+        )
+
+    for f in new:
+        click.echo(f.render())
+    if new:
+        by_rule = {}
+        for f in new:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+        breakdown = ", ".join(
+            f"{k}: {v}" for k, v in sorted(by_rule.items())
+        )
+        click.echo(
+            f"\n{len(new)} finding(s) ({breakdown})"
+            + (f"; {len(baselined)} baselined" if baselined else ""),
+            err=True,
+        )
+        sys.exit(1)
+    click.echo(
+        f"clean ({len(baselined)} baselined finding(s))"
+        if baselined
+        else "clean"
+    )
+
+
+if __name__ == "__main__":
+    main()

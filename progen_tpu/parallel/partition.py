@@ -174,7 +174,7 @@ def initialize_distributed() -> None:
     if workers > 1:
         try:
             jax.distributed.initialize()
-        except Exception as e:  # noqa: BLE001 — converted into a loud abort
+        except Exception as e:  # blind on purpose — converted to a loud abort
             raise RuntimeError(
                 f"TPU_WORKER_HOSTNAMES lists {workers} workers but "
                 "jax.distributed.initialize() failed; refusing to run as "
@@ -187,7 +187,7 @@ def initialize_distributed() -> None:
     if metadata_ok and has_tpu_dev:
         try:
             jax.distributed.initialize()
-        except Exception as e:  # noqa: BLE001
+        except Exception as e:  # blind on purpose, same abort as above
             if os.environ.get("TPU_WORKER_ID"):
                 # a pod runtime set a worker id: this host IS part of a
                 # multi-worker slice, so a detect failure must not degrade

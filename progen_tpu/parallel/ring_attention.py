@@ -32,6 +32,34 @@ from jax.sharding import Mesh, PartitionSpec as P
 from progen_tpu.ops.attention import local_attention
 from progen_tpu.parallel.partition import shard_map
 
+# one ring_check_vma telemetry event per distinct configuration per
+# process: ring_local_attention is traced once per layer per compile,
+# and the evidence record only needs to exist, not repeat
+_CHECK_VMA_SEEN: set = set()
+
+
+def _record_check_vma(*, use_pallas: bool, interpret: bool,
+                      check_vma: bool, override) -> None:
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        backend = "unknown"
+    config = (backend, bool(use_pallas), bool(interpret),
+              bool(check_vma), override)
+    if config in _CHECK_VMA_SEEN:
+        return
+    _CHECK_VMA_SEEN.add(config)
+    from progen_tpu.telemetry import get_telemetry
+
+    get_telemetry().emit({
+        "ev": "ring_check_vma",
+        "backend": backend,
+        "use_pallas": bool(use_pallas),
+        "interpret": bool(interpret),
+        "check_vma": bool(check_vma),
+        "override": override,
+    })
+
 
 def ring_local_attention(
     q: jnp.ndarray,
@@ -131,10 +159,22 @@ def ring_local_attention(
     override = os.environ.get("PROGEN_RING_CHECK_VMA")
     if override in ("0", "1"):
         check_vma = override == "1"
-    return shard_map(
+    out = shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
         check_vma=check_vma,
     )(q, k, v)
+    # evidence for the policy above: the shard_map applied cleanly WITH
+    # this checker setting, on this backend. Emitted at trace time (once
+    # per compiled configuration, deduped below), so a TPU bench/dryrun
+    # trace carries a positive record that the compiled-pallas + checker
+    # combination survived — the case that is untestable off-TPU.
+    _record_check_vma(
+        use_pallas=use_pallas,
+        interpret=interpret,
+        check_vma=check_vma,
+        override=override,
+    )
+    return out

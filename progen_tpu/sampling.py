@@ -316,6 +316,8 @@ def _cache_init_fn(model, sharding, batch: int = 1):
         from jax.sharding import NamedSharding, PartitionSpec
 
         out_shardings = NamedSharding(sharding.mesh, PartitionSpec())
+    # progen: ignore[PGL004] — the fresh lambda is jitted at most once per
+    # (model, batch, sharding) tuple: the enclosing lru_cache is the cache
     return jax.jit(
         lambda: model.init(
             jax.random.PRNGKey(0), jnp.zeros((batch, 1), jnp.int32)
@@ -369,7 +371,7 @@ def _decode_setup(model, params, batch: int):
         # scanned stacked layout
         params = unstack_params(params, model.config)
     param_leaf = next(
-        (l for l in jax.tree.leaves(params) if isinstance(l, jax.Array)),
+        (leaf for leaf in jax.tree.leaves(params) if isinstance(leaf, jax.Array)),
         None,
     )
     sharding = param_leaf.sharding if param_leaf is not None else None

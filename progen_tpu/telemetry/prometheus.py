@@ -84,7 +84,7 @@ def write_prometheus(path, text: str) -> None:
 class _Handler(http.server.BaseHTTPRequestHandler):
     render: Callable[[], str]  # set per-server via subclassing
 
-    def do_GET(self):  # noqa: N802 (stdlib handler contract)
+    def do_GET(self):  # camelCase: BaseHTTPRequestHandler contract
         try:
             body = type(self).render().encode()
         except Exception as e:  # a render bug must not kill the server

@@ -99,7 +99,7 @@ def make_train_step(
             grad_norm = optax.global_norm(grads)
             with jax.named_scope("finite_gate"):
                 ok = jnp.isfinite(losses).all() & jnp.isfinite(grad_norm)
-                gate = lambda new, old: jnp.where(ok, new, old)  # noqa: E731
+                gate = lambda new, old: jnp.where(ok, new, old)
                 params = jax.tree.map(gate, params, state.params)
                 opt_state = jax.tree.map(gate, opt_state, state.opt_state)
             # step still advances on a refusal — the batch was consumed,

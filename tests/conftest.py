@@ -23,8 +23,8 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-import jax  # noqa: E402
-import jax._src.xla_bridge as _xb  # noqa: E402
+import jax
+import jax._src.xla_bridge as _xb
 
 assert not _xb.backends_are_initialized(), (
     "conftest must run before any jax backend initializes"

@@ -1,0 +1,20 @@
+"""PGL001 true negatives: expected findings: 0."""
+
+import jax
+
+
+@jax.jit
+def static_ok(x):
+    # float() of a trace-time-constant expression is a Python float,
+    # not a tracer read
+    return x * float(x.shape[0] + 1)
+
+
+def host_fence(x):
+    # outside any traced region: the intended host-side fence
+    return float(x.mean())
+
+
+@jax.jit
+def suppressed(x):
+    return float(x.mean())  # progen: ignore[PGL001]

@@ -1,0 +1,23 @@
+"""PGL001 true positives: host-device syncs inside traced regions.
+
+Expected findings: 3 (lines marked TP). Never executed — parsed only.
+"""
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def loss_with_sync(x):
+    m = x.mean()
+    return float(m)  # TP: float() on a traced value
+
+
+@jax.jit
+def fetch(x):
+    return np.asarray(x) + 1  # TP: np.asarray pulls to host
+
+
+@jax.jit
+def item_read(x):
+    return x.sum().item()  # TP: .item() host read

@@ -1,0 +1,37 @@
+"""PGL002 true negatives: expected findings: 0."""
+
+import jax
+
+
+def split_ok(key):
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, (4,))
+    b = jax.random.uniform(k2, (4,))
+    return a + b
+
+
+def fold_ok(key, steps):
+    outs = []
+    for i in range(steps):
+        # fold_in derives a child without consuming the parent
+        k = jax.random.fold_in(key, i)
+        outs.append(jax.random.normal(k, (2,)))
+    return outs
+
+
+def feature_key(data: bytes, key: bytes = b"seq"):
+    # key-named param pinned to a host type: not a PRNG key
+    return decode(data, key), decode(data, key)
+
+
+def branch_return(key, flag):
+    # the consuming branch returns, so only one draw happens per call
+    if flag:
+        return jax.random.normal(key, (2,))
+    return jax.random.uniform(key, (2,))
+
+
+def eval_shape_ok(init_fn, rng):
+    # eval_shape is abstract: traces shapes only, draws no bits
+    abstract = jax.eval_shape(init_fn, rng)
+    return abstract, jax.jit(init_fn)(rng)

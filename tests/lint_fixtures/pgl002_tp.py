@@ -1,0 +1,17 @@
+"""PGL002 true positives: RNG key reuse. Expected findings: 2."""
+
+import jax
+
+
+def sample_twice(key):
+    a = jax.random.normal(key, (4,))
+    b = jax.random.uniform(key, (4,))  # TP: same key, same bits
+    return a + b
+
+
+def loop_reuse(key, xs):
+    out = []
+    for x in xs:
+        # TP: consumed again on the simulated second iteration
+        out.append(jax.random.normal(key, (2,)) + x)
+    return out

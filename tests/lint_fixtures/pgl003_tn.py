@@ -1,0 +1,20 @@
+"""PGL003 true negatives: expected findings: 0."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def train_step(state, batch):
+    return state + batch
+
+
+def rebound_each_iteration(state, batches):
+    for b in batches:
+        state = train_step(state, b)  # rebind: the canonical pattern
+    return state
+
+
+def donate_then_done(state, batch):
+    return train_step(state, batch)  # no read after the call

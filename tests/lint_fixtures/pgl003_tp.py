@@ -1,0 +1,24 @@
+"""PGL003 true positives: donated buffer read after the call.
+
+Expected findings: 2.
+"""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def train_step(state, batch):
+    return state + batch
+
+
+def read_after_donate(state, batch):
+    out = train_step(state, batch)
+    return out, state  # TP: state's buffer was donated above
+
+
+def loop_without_rebind(state, batches):
+    for b in batches:
+        _ = train_step(state, b)  # TP: second iteration reads donated state
+    return None

@@ -1,0 +1,31 @@
+"""PGL004 true negatives: expected findings: 0."""
+
+import functools
+
+import jax
+
+# module-scope jit-of-lambda compiles once per process: fine
+_fwd = jax.jit(lambda v: v + 1)
+
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def step(x, mode):
+    return x
+
+
+def literal_static(x):
+    return step(x, "train")
+
+
+@jax.jit
+def sentinel_branch(x, lo=None):
+    if lo is None:  # identity check on a default sentinel: trace-time
+        return x
+    return x + lo
+
+
+@jax.jit
+def shape_branch(x):
+    if x.shape[0] > 4:  # .shape is trace-time Python, not a tracer read
+        return x[:4]
+    return x

@@ -1,0 +1,29 @@
+"""PGL004 true positives: recompilation hazards. Expected findings: 4."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def step(x, mode):
+    return x
+
+
+def call_with_fstring(x, i):
+    return step(x, f"mode-{i}")  # TP: varying string into a static arg
+
+
+def call_with_list(x):
+    return step(x, ["a", "b"])  # TP: unhashable static arg
+
+
+def jit_fresh_lambda(x):
+    return jax.jit(lambda v: v + 1)(x)  # TP: new cache entry per call
+
+
+@jax.jit
+def traced_branch(x, lo):
+    if x > lo:  # TP: Python branch on traced params
+        return x
+    return lo

@@ -1,0 +1,20 @@
+"""PGL005 true negatives: expected findings: 0."""
+
+import jax
+
+
+@jax.jit
+def debug_ok(x):
+    jax.debug.print("x = {x}", x=x)  # sanctioned effect escape hatch
+    return x
+
+
+def host_log(x, tracker):
+    tracker.log({"x": float(x)})  # not traced: ordinary host logging
+    return x
+
+
+@jax.jit
+def banner(x):
+    print("compiling banner")  # progen: ignore[PGL005]
+    return x

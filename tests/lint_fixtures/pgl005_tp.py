@@ -1,0 +1,17 @@
+"""PGL005 true positives: side effects in traced code. Expected: 2."""
+
+import jax
+
+
+@jax.jit
+def noisy(x):
+    print("step", x)  # TP: runs once, at trace time
+    return x
+
+
+def scanned(xs, tracker):
+    def body(carry, x):
+        tracker.log({"x": 1})  # TP: scan body is traced
+        return carry, x
+
+    return jax.lax.scan(body, 0, xs)

@@ -1,0 +1,14 @@
+"""PGL006 true positives: telemetry hygiene. Expected findings: 3."""
+
+
+def unbounded_span(telemetry, name):
+    with telemetry.span(f"load/{name}"):  # TP: f-string span name
+        pass
+
+
+def raw_begin_record(emit):
+    emit({"ev": "B", "span": "x", "id": 1})  # TP: raw B outside span()
+
+
+def slash_metric(reg):
+    reg.inc("tokens/sec")  # TP: '/' fails the Prometheus name grammar

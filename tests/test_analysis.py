@@ -1,0 +1,292 @@
+"""progen-tpu-lint: fixture corpus per rule, suppression + baseline
+mechanics, the CLI exit-code contract, and the self-lint gate (the whole
+repo must be clean modulo lint_baseline.json — the same invariant CI
+enforces)."""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from progen_tpu.analysis import (
+    RULE_DOCS,
+    RULES,
+    BaselineError,
+    discover_files,
+    lint_file,
+    lint_paths,
+    load_baseline,
+    report_json,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = Path(__file__).resolve().parent / "lint_fixtures"
+
+# rule id -> expected true-positive finding count in its _tp fixture
+EXPECTED_TP = {
+    "PGL001": 3,
+    "PGL002": 2,
+    "PGL003": 2,
+    "PGL004": 4,
+    "PGL005": 2,
+    "PGL006": 3,
+}
+
+
+class TestFixtureCorpus:
+    @pytest.mark.parametrize("rule_id", sorted(EXPECTED_TP))
+    def test_true_positives(self, rule_id):
+        path = FIXTURES / f"{rule_id.lower()}_tp.py"
+        findings = lint_file(path)
+        of_rule = [f for f in findings if f.rule == rule_id]
+        assert len(of_rule) == EXPECTED_TP[rule_id], [
+            f.render() for f in findings
+        ]
+        # the TP fixture must not trip OTHER rules either — cross-rule
+        # noise in the corpus would mask regressions
+        assert len(findings) == len(of_rule), [f.render() for f in findings]
+
+    @pytest.mark.parametrize("rule_id", sorted(EXPECTED_TP))
+    def test_true_negatives(self, rule_id):
+        path = FIXTURES / f"{rule_id.lower()}_tn.py"
+        findings = lint_file(path)
+        assert findings == [], [f.render() for f in findings]
+
+    def test_every_rule_has_fixtures(self):
+        ids = {r.id for r in RULES}
+        assert ids == set(EXPECTED_TP)
+        for rule_id in ids:
+            assert (FIXTURES / f"{rule_id.lower()}_tp.py").is_file()
+            assert (FIXTURES / f"{rule_id.lower()}_tn.py").is_file()
+
+    def test_findings_carry_location_and_func(self):
+        findings = lint_file(FIXTURES / "pgl001_tp.py")
+        f = findings[0]
+        assert f.line > 0 and f.func == "loss_with_sync"
+        assert "pgl001_tp.py" in f.render()
+        assert f.to_json()["rule"] == "PGL001"
+
+
+class TestSuppressions:
+    def test_inline_same_line(self, tmp_path):
+        p = tmp_path / "m.py"
+        p.write_text(
+            "import jax\n\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return float(x)  # progen: ignore[PGL001]\n"
+        )
+        assert lint_file(p) == []
+
+    def test_standalone_comment_above(self, tmp_path):
+        p = tmp_path / "m.py"
+        p.write_text(
+            "import jax\n\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    # progen: ignore[PGL001]\n"
+            "    # justification may continue over several lines\n"
+            "    return float(x)\n"
+        )
+        assert lint_file(p) == []
+
+    def test_bare_ignore_suppresses_all(self, tmp_path):
+        p = tmp_path / "m.py"
+        p.write_text(
+            "import jax\n\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    print(float(x))  # progen: ignore\n"
+        )
+        assert lint_file(p) == []
+
+    def test_wrong_rule_does_not_suppress(self, tmp_path):
+        p = tmp_path / "m.py"
+        p.write_text(
+            "import jax\n\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return float(x)  # progen: ignore[PGL005]\n"
+        )
+        assert [f.rule for f in lint_file(p)] == ["PGL001"]
+
+
+class TestBaseline:
+    def test_reason_is_mandatory(self, tmp_path):
+        p = tmp_path / "b.json"
+        p.write_text(json.dumps([{"rule": "PGL001", "path": "x.py"}]))
+        with pytest.raises(BaselineError, match="reason"):
+            load_baseline(p)
+
+    def test_findings_wrapper_accepted(self, tmp_path):
+        p = tmp_path / "b.json"
+        p.write_text(json.dumps({"findings": [
+            {"rule": "PGL001", "path": "x.py", "reason": "legacy"}
+        ]}))
+        assert len(load_baseline(p)) == 1
+
+    def test_baseline_splits_new_from_grandfathered(self, tmp_path):
+        src = tmp_path / "m.py"
+        src.write_text(
+            "import jax\n\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return float(x)\n\n"
+            "@jax.jit\n"
+            "def g(x):\n"
+            "    return float(x)\n"
+        )
+        baseline = [
+            {"rule": "PGL001", "path": "m.py", "func": "f",
+             "reason": "grandfathered"}
+        ]
+        new, matched = lint_paths([src], baseline=baseline)
+        assert [f.func for f in matched] == ["f"]
+        assert [f.func for f in new] == ["g"]
+
+    def test_path_matches_by_suffix(self, tmp_path):
+        sub = tmp_path / "deep" / "nested"
+        sub.mkdir(parents=True)
+        src = sub / "m.py"
+        src.write_text(
+            "import jax\n\n@jax.jit\ndef f(x):\n    return float(x)\n"
+        )
+        baseline = [
+            {"rule": "PGL001", "path": "nested/m.py", "reason": "ok"}
+        ]
+        new, matched = lint_paths([src], baseline=baseline)
+        assert new == [] and len(matched) == 1
+
+    def test_checked_in_baseline_loads_and_validates(self):
+        entries = load_baseline(REPO / "lint_baseline.json")
+        assert entries, "repo baseline exists and is non-empty"
+        for e in entries:
+            assert e["reason"].strip()
+
+    def test_report_json_shape(self):
+        findings = lint_file(FIXTURES / "pgl006_tp.py")
+        rep = report_json(findings, [])
+        assert rep["tool"] == "progen-tpu-lint"
+        assert rep["summary"]["new"] == len(findings)
+        assert rep["summary"]["by_rule"]["PGL006"] == len(findings)
+        assert set(rep["rules"]) == set(RULE_DOCS)
+
+
+class TestSelfLint:
+    """The invariant CI enforces: the repo lints clean modulo baseline."""
+
+    def test_repo_is_clean_modulo_baseline(self):
+        baseline = load_baseline(REPO / "lint_baseline.json")
+        new, _ = lint_paths(
+            [REPO / "progen_tpu", REPO / "tests",
+             REPO / "bench.py", REPO / "__graft_entry__.py"],
+            baseline=baseline,
+        )
+        assert new == [], "\n".join(f.render() for f in new)
+
+    def test_fixture_corpus_excluded_from_discovery(self):
+        files = discover_files([REPO / "tests"])
+        assert not any("lint_fixtures" in str(f) for f in files)
+
+    def test_no_stale_baseline_entries(self):
+        """Every baseline entry still matches a real finding — entries
+        whose defect was fixed must be deleted, or the baseline rots."""
+        baseline = load_baseline(REPO / "lint_baseline.json")
+        _, matched = lint_paths(
+            [REPO / "progen_tpu", REPO / "tests",
+             REPO / "bench.py", REPO / "__graft_entry__.py"],
+            baseline=baseline,
+        )
+        from progen_tpu.analysis.runner import _baseline_matches
+
+        stale = [
+            e for e in baseline
+            if not any(_baseline_matches(e, f) for f in matched)
+        ]
+        assert stale == [], f"stale baseline entries: {stale}"
+
+
+class TestCli:
+    def _run(self, *args):
+        from click.testing import CliRunner
+
+        from progen_tpu.cli.lint import main
+
+        return CliRunner(mix_stderr=True).invoke(main, list(args)) \
+            if _mix_stderr_supported() else \
+            CliRunner().invoke(main, list(args))
+
+    def test_clean_file_exits_zero(self):
+        res = self._run("--no-baseline", str(FIXTURES / "pgl001_tn.py"))
+        assert res.exit_code == 0, res.output
+
+    def test_findings_exit_one_and_print(self):
+        res = self._run("--no-baseline", str(FIXTURES / "pgl001_tp.py"))
+        assert res.exit_code == 1
+        assert "PGL001" in res.output
+
+    def test_json_report_written(self, tmp_path):
+        out = tmp_path / "report.json"
+        res = self._run(
+            "--no-baseline", "--json", str(out),
+            str(FIXTURES / "pgl004_tp.py"),
+        )
+        assert res.exit_code == 1
+        rep = json.loads(out.read_text())
+        assert rep["summary"]["by_rule"]["PGL004"] == 4
+
+    def test_malformed_baseline_exits_two(self, tmp_path):
+        bad = tmp_path / "b.json"
+        bad.write_text(json.dumps([{"rule": "PGL001", "path": "x.py"}]))
+        res = self._run(
+            "--baseline", str(bad), str(FIXTURES / "pgl001_tn.py")
+        )
+        assert res.exit_code == 2
+
+    def test_list_rules(self):
+        res = self._run("--list-rules")
+        assert res.exit_code == 0
+        for rule_id in RULE_DOCS:
+            assert rule_id in res.output
+
+    def test_lint_is_jax_free(self):
+        """The gate must run in a bare CI step: importing the analysis
+        package and CLI must not import jax."""
+        import subprocess
+        import sys
+
+        code = (
+            "import sys; import progen_tpu.analysis, progen_tpu.cli.lint; "
+            "sys.exit(1 if 'jax' in sys.modules else 0)"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True
+        )
+        assert proc.returncode == 0, proc.stderr.decode()
+
+
+def _mix_stderr_supported() -> bool:
+    import inspect
+
+    from click.testing import CliRunner
+
+    return "mix_stderr" in inspect.signature(CliRunner.__init__).parameters
+
+
+class TestRuffConfig:
+    def test_pyproject_configures_ruff(self):
+        text = (REPO / "pyproject.toml").read_text()
+        assert "[tool.ruff]" in text
+        assert "[tool.ruff.lint]" in text
+
+    def test_ruff_passes_when_available(self):
+        import shutil
+        import subprocess
+
+        ruff = shutil.which("ruff")
+        if ruff is None:
+            pytest.skip("ruff not installed in this environment")
+        proc = subprocess.run(
+            [ruff, "check", "."], cwd=REPO, capture_output=True
+        )
+        assert proc.returncode == 0, proc.stdout.decode()

@@ -174,7 +174,7 @@ class TestOrchestrator:
             bench, monkeypatch, tmp_path, capsys,
             {"train-tiny": tiny, "kernel-w256": kern},
         )
-        payloads = [json.loads(l) for l in lines if l.startswith("{")]
+        payloads = [json.loads(line) for line in lines if line.startswith("{")]
         assert len(payloads) == 2  # early headline + final rich line
         head, final = payloads
         assert head["metric"] == "train_tokens_per_sec_per_chip"
@@ -281,7 +281,7 @@ class TestResume:
         bench.main()
 
         lines = capsys.readouterr().out.strip().splitlines()
-        payloads = [json.loads(l) for l in lines if l.startswith("{")]
+        payloads = [json.loads(line) for line in lines if line.startswith("{")]
         # wedge insurance: the prior headline must be flushed BEFORE any
         # rerun phase output, then repeated in the final rich line
         assert payloads[0]["value"] == 200000.0

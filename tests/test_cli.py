@@ -351,8 +351,8 @@ def test_train_telemetry_events(workspace, monkeypatch):
     run_dir = runs[-1]
 
     events = [
-        json.loads(l)
-        for l in (run_dir / "events.jsonl").read_text().splitlines()
+        json.loads(line)
+        for line in (run_dir / "events.jsonl").read_text().splitlines()
     ]
     spans = {r["span"] for r in events if r.get("ev") == "B"}
     assert "train/compile" in spans
@@ -363,8 +363,8 @@ def test_train_telemetry_events(workspace, monkeypatch):
     assert sorted(opened) == sorted(closed)
 
     metrics = [
-        json.loads(l)
-        for l in (run_dir / "metrics.jsonl").read_text().splitlines()
+        json.loads(line)
+        for line in (run_dir / "metrics.jsonl").read_text().splitlines()
     ]
     goodput = [m for m in metrics if "goodput_pct" in m]
     assert goodput, "no goodput record logged"

@@ -259,7 +259,7 @@ class TestFastaETL:
             "num_sequences_per_file": 2,
             "sort_annotations": True,
         }
-        written = generate_data(cfg, seed=0)
+        generate_data(cfg, seed=0)
         # 3 records, 2 with annotations -> 5 strings; 2 valid, 3 train
         num_train, it = iterator_from_tfrecords_folder(str(tmp_path / "out"))
         num_valid, _ = iterator_from_tfrecords_folder(

@@ -3,7 +3,6 @@ output, cross-readability, CRC agreement with google_crc32c, corruption
 detection, and a perf sanity check."""
 
 import gzip
-import os
 import time
 
 import pytest
@@ -35,7 +34,7 @@ class TestCrc:
 
 class TestCodecParity:
     def test_encode_record_matches_python(self):
-        lib = _native.load()
+        _native.load()
         seq = b"# MGHKLVAATT"
         native = _native.encode_record(seq)
         import io

@@ -1,8 +1,6 @@
 """Pallas windowed-attention kernel vs the XLA golden (interpret mode on
 CPU; the same kernel runs compiled on TPU — see bench.py)."""
 
-import functools
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -406,7 +404,6 @@ class TestLayerPolicyDispatch:
 
     def _recorded_call(self, monkeypatch, window, seq, bh_block=0,
                        tmp_path=None):
-        import progen_tpu.models.layers as layers_mod
         import progen_tpu.ops.pallas_attention as pa
 
         if tmp_path is not None:

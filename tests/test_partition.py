@@ -247,11 +247,11 @@ class TestLargeConfigHbmFit:
         shard_leaves = jax.tree.leaves(shardings)
         assert len(leaves) == len(shard_leaves)
         total = sum(
-            int(np.prod(l.shape)) * l.dtype.itemsize for l in leaves
+            int(np.prod(leaf.shape)) * leaf.dtype.itemsize for leaf in leaves
         )
         per_chip = sum(
-            int(np.prod(s.shard_shape(l.shape))) * l.dtype.itemsize
-            for l, s in zip(leaves, shard_leaves)
+            int(np.prod(s.shard_shape(leaf.shape))) * leaf.dtype.itemsize
+            for leaf, s in zip(leaves, shard_leaves)
         )
         # sanity: ~1.2B params x 12 B (f32 params + Adam m/v) ~ 14.7 GB
         assert total > 12 * 1.2e9

@@ -8,7 +8,6 @@ kill matrix (test_chaos_matrix.py).
 """
 
 import io
-import json
 import random
 import time
 

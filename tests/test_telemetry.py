@@ -42,7 +42,7 @@ def test_span_emits_begin_end_records(tmp_path):
         pass
     log.close()
     recs = [
-        json.loads(l) for l in (tmp_path / "events.jsonl").read_text().splitlines()
+        json.loads(line) for line in (tmp_path / "events.jsonl").read_text().splitlines()
     ]
     assert [r["ev"] for r in recs] == ["B", "E"]
     assert all(r["span"] == "ckpt/save" and r["step"] == 7 for r in recs)
@@ -324,7 +324,7 @@ def test_sigkill_leaves_parseable_jsonl(tmp_path):
         raw = (tmp_path / "proj" / "runA" / name).read_bytes()
         lines = raw.split(b"\n")
         complete, last = lines[:-1], lines[-1]
-        recs = [json.loads(l) for l in complete if l.strip()]
+        recs = [json.loads(line) for line in complete if line.strip()]
         assert len(recs) >= min_recs, f"{name}: lost flushed records"
         # only the final (killed mid-write) line may be partial
         if last:
@@ -339,8 +339,8 @@ def test_tracker_log_event_writes_events_jsonl(tmp_path):
     tr.log_event({"ev": "B", "span": "x"})
     tr.finish()
     recs = [
-        json.loads(l)
-        for l in (tmp_path / "proj" / "runB" / "events.jsonl")
+        json.loads(line)
+        for line in (tmp_path / "proj" / "runB" / "events.jsonl")
         .read_text().splitlines()
     ]
     assert recs == [{"ev": "B", "span": "x"}]
@@ -371,7 +371,7 @@ def _hammer_jsonl(emit, n_threads=8, n_records=200):
 
 
 def _assert_whole_lines(path, n_threads=8, n_records=200):
-    recs = [json.loads(l) for l in path.read_text().splitlines()]
+    recs = [json.loads(line) for line in path.read_text().splitlines()]
     assert len(recs) == n_threads * n_records  # nothing torn, nothing lost
     for t in range(n_threads):
         mine = [r["i"] for r in recs if r["tid_"] == t]
@@ -440,8 +440,8 @@ def test_span_records_carry_pid_tid_thread(tmp_path):
     tel.emit({"ev": "retry", "label": "io"})
     log.close()
     recs = [
-        json.loads(l)
-        for l in (tmp_path / "ev.jsonl").read_text().splitlines()
+        json.loads(line)
+        for line in (tmp_path / "ev.jsonl").read_text().splitlines()
     ]
     b, e, retry = recs
     assert b["pid"] == e["pid"] == retry["pid"] == 0  # single process

@@ -18,8 +18,8 @@ class TestJsonlTracker:
         t.log({"loss": 1.2, "mfu": 0.4}, step=4)
         t.finish()
         rows = [
-            json.loads(l)
-            for l in (tmp_path / "proj" / t.run_id / "metrics.jsonl")
+            json.loads(line)
+            for line in (tmp_path / "proj" / t.run_id / "metrics.jsonl")
             .read_text()
             .splitlines()
         ]

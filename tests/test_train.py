@@ -15,7 +15,6 @@ from progen_tpu.models.progen import ProGen
 from progen_tpu.parallel.partition import make_mesh
 from progen_tpu.training.loss import cross_entropy, eos_loss_mask
 from progen_tpu.training.optimizer import make_optimizer, weight_decay_mask
-from progen_tpu.training.state import TrainState
 from progen_tpu.training.step import (
     compile_train_step,
     init_train_state,
@@ -303,7 +302,6 @@ class TestLrSchedule:
 
     def test_scheduled_optimizer_trains(self):
         from progen_tpu.training.optimizer import make_optimizer
-        from progen_tpu.training.state import TrainState
         from progen_tpu.training.step import make_train_step
 
         model = ProGen(TINY)
