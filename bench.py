@@ -170,6 +170,11 @@ _PHASES = (
     # train phases so their policy lookup is backed by a measurement at the
     # shape they actually run, writing ops/pallas_policy.json on a clean run
     ("kernel-w512-n8192", 600),
+    # fused layer kernels (standalone Mosaic compiles like kernel-w*,
+    # not the slow whole-program train-step embedding): writes the
+    # layer_entries policy rows the fused-flag train runs read
+    ("kernel-fused-w256", 420),
+    ("kernel-fused-w512", 420),
     ("train-default", 600),
     ("train-base", 720),
     ("train-long8k-xla", 1080),
@@ -180,6 +185,9 @@ _PHASES = (
     # serving engine under staggered arrivals (steady-state tokens/s +
     # TTFT); two jits only, shapes shared with decode-tiny's policy
     ("decode-serve", 600),
+    # int8 weight-quantized decode vs fp on the same params (quant
+    # compile cost rides the engine build; two decode jits total)
+    ("decode-int8", 600),
     # sustained base run: 100+ steps + async ckpt + exactness-checked
     # restore (the production-claim proxy); long, so late in the order
     ("sustain-base", 1200),
@@ -730,6 +738,176 @@ def _sgu_mix_bench() -> dict:
     }
 
 
+def _fused_kernel_bench(block: int) -> dict:
+    """Fused Pallas layer kernels (ops/pallas_layers.py) vs their
+    unfused XLA references, fwd+bwd: the shift->norm halo kernel and the
+    SGU mix+gate kernel that keeps the normalized gate VMEM-resident
+    across norm/causal-mix/gating and skips the structurally-zero upper
+    triangle in-grid. On TPU a clean run (numerics pass, timings not
+    suspect) writes the measured winners into pallas_policy.json's
+    layer_entries; off-TPU the kernels run in interpret mode — a
+    functional smoke whose timings are never policy evidence."""
+    import jax
+    import jax.numpy as jnp
+
+    from progen_tpu.ops.pallas_layers import (
+        LAYER_PALLAS_OK,
+        fused_norm_shift,
+        fused_sgu_mix_gate,
+        norm_shift_reference,
+        record_layer_policy_entry,
+        sgu_mix_gate_reference,
+    )
+
+    phase = f"kernel-fused-w{block}"
+    if not LAYER_PALLAS_OK:
+        return {"phase": phase,
+                "error": "pallas layer-kernel API unavailable on this jax"}
+
+    on_tpu = _is_tpu_platform(jax.devices()[0].platform)
+    interpret = not on_tpu
+    if on_tpu:
+        b, n, d, d_half, iters, bn = 4, 1024, 512, 1024, 10, block
+    else:  # smoke shapes: interpret mode is minutes/iter at TPU shapes
+        b, n, d, d_half, iters, bn = 2, 128, 64, 64, 3, min(block, 32)
+    eps = 1e-5
+    kx, kxg, kg, kw = jax.random.split(jax.random.PRNGKey(0), 4)
+    x = jax.random.normal(kx, (b, n, d), jnp.bfloat16)
+    scale = jnp.full((d,), 1.1, jnp.float32)
+    xg = jax.random.normal(kxg, (b, n, d_half), jnp.bfloat16)
+    gate = jax.random.normal(kg, (b, n, d_half), jnp.bfloat16)
+    gscale = jnp.full((d_half,), 0.9, jnp.float32)
+    w = jax.random.normal(kw, (n, n), jnp.float32) / n
+    bias = jnp.ones((n, 1), jnp.float32)
+    _mark(f"{phase}: b{b} n{n} d{d} dh{d_half} bn{bn} "
+          f"interpret={interpret}")
+
+    def ns_fused(x, s):
+        return fused_norm_shift(x, s, eps, bn, interpret, "bfloat16")
+
+    def ns_ref(x, s):
+        return norm_shift_reference(x, s, eps, "bfloat16")
+
+    def sgu_fused(x, g, w, s):
+        return fused_sgu_mix_gate(x, g, w, bias, s, eps, bn, interpret,
+                                  "bfloat16")
+
+    def sgu_ref(x, g, w, s):
+        return sgu_mix_gate_reference(x, g, w, bias, s, eps, "bfloat16")
+
+    def timed(fn, *args, bwd=False):
+        if bwd:
+            def loss(*a):
+                return fn(*a).astype(jnp.float32).sum()
+
+            run = jax.jit(jax.grad(loss, argnums=tuple(range(len(args)))))
+        else:
+            run = jax.jit(fn)
+        t0 = time.perf_counter()
+        out = run(*args)
+        _value_fence(out)
+        _account("compile", time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = run(*args)
+        _value_fence(out)
+        dt = time.perf_counter() - t0
+        _account("step", dt)
+        return dt / iters
+
+    # numerics BEFORE timing: a fast wrong kernel must never become a
+    # policy winner (bf16 paths are bit-identical by construction; the
+    # tolerance covers f32-accumulation reassociation only)
+    err_ns = float(jnp.max(jnp.abs(
+        ns_fused(x, scale).astype(jnp.float32)
+        - ns_ref(x, scale).astype(jnp.float32)
+    )))
+    err_sgu = float(jnp.max(jnp.abs(
+        sgu_fused(xg, gate, w, gscale).astype(jnp.float32)
+        - sgu_ref(xg, gate, w, gscale).astype(jnp.float32)
+    )))
+    numerics_ok = err_ns <= 0.05 and err_sgu <= 0.05
+
+    t_ns_ref_f = timed(ns_ref, x, scale)
+    t_ns_fused_f = timed(ns_fused, x, scale)
+    t_ns_ref_b = timed(ns_ref, x, scale, bwd=True)
+    t_ns_fused_b = timed(ns_fused, x, scale, bwd=True)
+    _mark(f"{phase}: norm_shift timed "
+          f"(fwd {t_ns_ref_f * 1e3:.2f} -> {t_ns_fused_f * 1e3:.2f} ms)")
+    t_sgu_ref_f = timed(sgu_ref, xg, gate, w, gscale)
+    t_sgu_fused_f = timed(sgu_fused, xg, gate, w, gscale)
+    t_sgu_ref_b = timed(sgu_ref, xg, gate, w, gscale, bwd=True)
+    t_sgu_fused_b = timed(sgu_fused, xg, gate, w, gscale, bwd=True)
+    _mark(f"{phase}: sgu timed "
+          f"(fwd {t_sgu_ref_f * 1e3:.2f} -> {t_sgu_fused_f * 1e3:.2f} ms)")
+
+    from progen_tpu import profiling as _prof
+
+    peak = _prof.peak_flops(jax.devices()[0])
+    dense_flops = 2 * b * n * n * d_half  # dense (n, n) mix, 2 FLOP/MAC
+    guard = _suspect_fields(
+        dense_flops, min(t_sgu_ref_f, t_sgu_fused_f / 0.5), peak
+    )  # fused does ~0.5x dense MACs (tril-only grid)
+
+    policy_written = False
+    if on_tpu and numerics_ok and not guard["timing_suspect"]:
+        record_layer_policy_entry({
+            "kind": "norm_shift", "n": n, "d": d,
+            "impl": "pallas" if t_ns_fused_f <= t_ns_ref_f else "xla",
+            "block": bn,
+            "fwd_ms": {"xla": round(t_ns_ref_f * 1e3, 3),
+                       "pallas": round(t_ns_fused_f * 1e3, 3)},
+            "bwd_ms": {"xla": round(t_ns_ref_b * 1e3, 3),
+                       "pallas": round(t_ns_fused_b * 1e3, 3)},
+            "source": phase,
+        })
+        record_layer_policy_entry({
+            "kind": "sgu_mix", "n": n, "d": d_half,
+            "impl": "pallas" if t_sgu_fused_f <= t_sgu_ref_f else "xla",
+            "block": bn,
+            "fwd_ms": {"xla": round(t_sgu_ref_f * 1e3, 3),
+                       "pallas": round(t_sgu_fused_f * 1e3, 3)},
+            "bwd_ms": {"xla": round(t_sgu_ref_b * 1e3, 3),
+                       "pallas": round(t_sgu_fused_b * 1e3, 3)},
+            "source": phase,
+        })
+        policy_written = True
+
+    return {
+        "phase": phase,
+        "timing_suspect": guard["timing_suspect"],
+        "implied_device_tflops": guard["implied_device_tflops"],
+        "shape": f"b{b} n{n} d{d} dh{d_half} bn{bn}",
+        "interpret": interpret,
+        # headline speedups = the SGU kernel (the O(n^2) one): the
+        # main() summary contract for kernel phases reads these keys
+        "fwd_speedup": round(t_sgu_ref_f / t_sgu_fused_f, 2),
+        "bwd_speedup": round(t_sgu_ref_b / t_sgu_fused_b, 2),
+        "norm_shift": {
+            "fwd_ms": {"xla": round(t_ns_ref_f * 1e3, 3),
+                       "pallas": round(t_ns_fused_f * 1e3, 3)},
+            "bwd_ms": {"xla": round(t_ns_ref_b * 1e3, 3),
+                       "pallas": round(t_ns_fused_b * 1e3, 3)},
+            "fwd_speedup": round(t_ns_ref_f / t_ns_fused_f, 2),
+            "bwd_speedup": round(t_ns_ref_b / t_ns_fused_b, 2),
+            "max_abs_err": err_ns,
+        },
+        "sgu_mix": {
+            "fwd_ms": {"xla": round(t_sgu_ref_f * 1e3, 3),
+                       "pallas": round(t_sgu_fused_f * 1e3, 3)},
+            "bwd_ms": {"xla": round(t_sgu_ref_b * 1e3, 3),
+                       "pallas": round(t_sgu_fused_b * 1e3, 3)},
+            "fwd_speedup": round(t_sgu_ref_f / t_sgu_fused_f, 2),
+            "bwd_speedup": round(t_sgu_ref_b / t_sgu_fused_b, 2),
+            "max_abs_err": err_sgu,
+        },
+        "numerics_ok": numerics_ok,
+        "policy_written": policy_written,
+        "platform": jax.devices()[0].platform,
+        **_hbm_stats(),
+    }
+
+
 def _calib_bench() -> dict:
     """Fence calibration: a chained bf16 matmul with KNOWN FLOPs. Each
     iteration consumes the previous result, so even a dispatch-ack
@@ -1205,6 +1383,110 @@ def _decode_serve_bench() -> dict:
     }
 
 
+def _decode_int8_bench() -> dict:
+    """Int8 weight-quantized decode (ops/quant.py, --int8 on the serve
+    CLI) vs the full-precision engine built from the SAME params: decode
+    tokens/s for each, the speedup, greedy-window token agreement, and
+    the calibration report the engine computed at load. Decode is
+    HBM-bandwidth-bound, so the win only shows on chip; off-TPU smoke
+    shapes prove function and agreement, not the bandwidth claim."""
+    import jax
+    import jax.numpy as jnp
+    from flax import linen as nn
+
+    from progen_tpu.data.tokenizer import encode_tokens
+    from progen_tpu.models.progen import ProGen
+    from progen_tpu.serving import ServeEngine
+
+    on_tpu = _is_tpu_platform(jax.devices()[0].platform)
+    config = (
+        _load_config("tiny", seq_len=512)
+        if on_tpu
+        else _load_config("smoke")
+    )
+    max_slots = 8 if on_tpu else 4
+    steps = 64 if on_tpu else 16
+    model = ProGen(config)
+    tokens = jnp.zeros((1, config.seq_len), jnp.int32)
+    params = nn.meta.unbox(
+        jax.jit(model.init)(jax.random.PRNGKey(0), tokens)["params"]
+    )
+    prime = jnp.asarray(encode_tokens("[tax=Mammalia] #"), jnp.int32)
+    gen_len = min(int(config.seq_len),
+                  int(prime.shape[0]) + 1 + steps + 8)
+
+    streams: dict = {}
+    results: dict = {}
+    engines: dict = {}
+    for label in ("fp", "int8"):
+        _mark(f"decode-int8: building {label} engine")
+        t0 = time.perf_counter()
+        eng = ServeEngine(model, params, max_slots=max_slots,
+                          max_len=config.seq_len,
+                          quantize_int8=(label == "int8"))
+        # same keys per slot in both engines -> streams comparable
+        for s in range(max_slots):
+            eng.prefill(s, prime, gen_len,
+                        key=jax.random.PRNGKey(7 + s))
+        eng.decode_step()  # warmup: pays the decode-step compile
+        _account("compile", time.perf_counter() - t0)
+        seq = []
+        live_tokens = 0
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            sampled, was_live, _fin = eng.decode_step()
+            seq.append((sampled, was_live))
+            live_tokens += int(was_live.sum())
+        wall = time.perf_counter() - t0
+        _account("step", wall)
+        streams[label] = seq
+        engines[label] = eng
+        results[label] = {
+            "tokens_per_sec": round(live_tokens / max(wall, 1e-9), 1),
+            "live_tokens": live_tokens,
+            "wall_s": wall,
+        }
+        _mark(f"decode-int8: {label} "
+              f"{results[label]['tokens_per_sec']} tok/s")
+
+    agree = total = 0
+    for (sa, la), (sb, lb) in zip(streams["fp"], streams["int8"]):
+        both = la & lb
+        total += int(both.sum())
+        agree += int((sa[both] == sb[both]).sum())
+
+    report = dict(engines["int8"].quant_report or {})
+    report.pop("leaves", None)  # per-leaf detail stays in the engine log
+
+    from progen_tpu import profiling as _prof
+
+    peak = _prof.peak_flops(jax.devices()[0])
+    fwd_tok = _prof.flops_per_token(config) / 3
+    guard = _suspect_fields(
+        results["fp"]["live_tokens"] * fwd_tok,
+        results["fp"]["wall_s"], peak,
+    )
+    return {
+        "phase": "decode-int8",
+        "timing_suspect": guard["timing_suspect"],
+        "implied_device_tflops": guard["implied_device_tflops"],
+        "config": "tiny-seq512" if on_tpu else "smoke",
+        "max_slots": max_slots,
+        "decode_steps": steps,
+        "int8_tokens_per_sec": results["int8"]["tokens_per_sec"],
+        "fp_tokens_per_sec": results["fp"]["tokens_per_sec"],
+        "speedup": round(
+            results["int8"]["tokens_per_sec"]
+            / max(results["fp"]["tokens_per_sec"], 1e-9), 2
+        ),
+        "token_agreement": round(agree / max(total, 1), 4),
+        "tokens_compared": total,
+        "calibration": report,
+        "platform": jax.devices()[0].platform,
+        **_hbm_stats(),
+    }
+
+
 def _data_io_bench() -> dict:
     """Host-side input-pipeline throughput: the from-scratch TFRecord
     codec (write + parse) and the C++ engine vs the pure-Python path, plus
@@ -1389,6 +1671,8 @@ def _cpu_smoke() -> dict:
 
 
 def run_phase(name: str) -> dict:
+    if name.startswith("kernel-fused-w"):
+        return _fused_kernel_bench(int(name[len("kernel-fused-w"):]))
     if name.startswith("kernel-w"):
         # "kernel-w<W>" or "kernel-w<W>-n<N>" (long-context shape variant)
         spec = name[len("kernel-w"):].split("-n")
@@ -1435,6 +1719,8 @@ def run_phase(name: str) -> dict:
         return _decode_bench()
     if name == "decode-serve":
         return _decode_serve_bench()
+    if name == "decode-int8":
+        return _decode_int8_bench()
     if name == "sustain-base":
         return _sustain_bench()
     if name == "sgu-mix":
@@ -1714,6 +2000,12 @@ def main() -> None:
                 "kv_tps": res["kv_cache_tokens_per_sec"],
                 "speedup": res["speedup"],
             }
+        elif ph == "decode-int8":
+            summary[ph] = {
+                "int8_tps": res["int8_tokens_per_sec"],
+                "speedup": res["speedup"],
+                "agreement": res["token_agreement"],
+            }
         elif ph == "calib-matmul":
             summary[ph] = {
                 "achieved_tflops": res["achieved_tflops"],
@@ -1735,6 +2027,57 @@ def kernel_main() -> None:
         "results": results,
         "platform": results[0]["platform"],
     }))
+
+
+def gate_main(argv: list) -> int:
+    """``python bench.py gate``: ratchet a headline tokens/s value
+    against the best prior round in the BENCH_r0N.json trajectory
+    (progen_tpu/utils/bench_gate). Value sources, highest precedence
+    first: ``--value N`` (synthetic / pre-measured), ``--from-json FILE``
+    (a bench headline or phase JSON carrying ``value``), else a fresh
+    CPU-fallback smoke measurement. Exit 0 within tolerance of the best
+    prior (or no prior: the value sets the bar), 1 on regression, 2 on
+    usage errors — the contract tier1.yml enforces."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="bench.py gate")
+    ap.add_argument("--value", type=float, default=None)
+    ap.add_argument("--from-json", default=None)
+    ap.add_argument("--metric", choices=("cpu", "tpu", "auto"),
+                    default="cpu")
+    ap.add_argument("--tolerance", type=float, default=0.2)
+    args = ap.parse_args(argv)
+
+    from progen_tpu.utils.bench_gate import run_gate
+
+    if args.value is not None:
+        value, source = args.value, "--value"
+    elif args.from_json:
+        try:
+            doc = json.loads(Path(args.from_json).read_text())
+        except (OSError, ValueError) as e:
+            print(f"gate: cannot read {args.from_json}: {e}",
+                  file=sys.stderr)
+            return 2
+        raw = doc.get("value") if isinstance(doc, dict) else None
+        if raw is None and isinstance(doc, dict) \
+                and isinstance(doc.get("parsed"), dict):
+            raw = doc["parsed"].get("value")
+        if raw is None:
+            print(f"gate: no 'value' in {args.from_json}",
+                  file=sys.stderr)
+            return 2
+        value, source = float(raw), args.from_json
+    else:
+        _force_cpu()
+        value, source = _cpu_smoke()["value"], "fresh cpu smoke"
+    try:
+        report = run_gate(value, args.metric, args.tolerance, _REPO)
+    except ValueError as e:
+        print(f"gate: {e}", file=sys.stderr)
+        return 2
+    print(json.dumps({"source": source, **report}, indent=1))
+    return 0 if report["ok"] else 1
 
 
 def _load_repo_env() -> None:
@@ -1827,6 +2170,8 @@ if __name__ == "__main__":
             print(json.dumps({"phase": sys.argv[2], "error": str(e)}))
     elif len(sys.argv) > 1 and sys.argv[1] == "kernel":
         kernel_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "gate":
+        sys.exit(gate_main(sys.argv[2:]))
     elif len(sys.argv) > 2 and sys.argv[1] == "--config":
         devs = _device_or_cpu_fallback()
         if not _is_tpu_platform(devs[0].platform) and sys.argv[2] != "smoke":

@@ -111,7 +111,8 @@ def _events_to_lines(events, completions, starts):
     return lines
 
 
-def _build(checkpoint_path, max_slots, max_len, max_queue):
+def _build(checkpoint_path, max_slots, max_len, max_queue,
+           quantize_int8=False):
     from progen_tpu.checkpoint import get_checkpoint_fns
     from progen_tpu.config import ProGenConfig
     from progen_tpu.models.progen import ProGen
@@ -126,7 +127,16 @@ def _build(checkpoint_path, max_slots, max_len, max_queue):
     engine = ServeEngine(
         model, pkg.state, max_slots=max_slots,
         max_len=min(max_len or config.seq_len, config.seq_len),
+        quantize_int8=quantize_int8,
     )
+    if engine.quant_report is not None:
+        r = engine.quant_report
+        print(
+            f"int8 weights: {r['quantized_leaves']} kernels, "
+            f"{r['bytes_fp']} -> {r['bytes_int8']} bytes, "
+            f"calib logits max-abs-err {r['logits_max_abs_err']:.3g}",
+            file=sys.stderr,
+        )
     return Scheduler(engine, max_queue=max_queue), engine
 
 
@@ -141,6 +151,10 @@ def _build(checkpoint_path, max_slots, max_len, max_queue):
 @click.option("--max-len", default=None, type=int,
               help="longest servable sequence (default: the model's "
                    "seq_len); also the per-request 'length' default")
+@click.option("--int8/--no-int8", "quantize_int8", default=False,
+              help="serve int8 weight-quantized matmuls (per-channel "
+                   "symmetric, dequant fused on-device); logs a "
+                   "max-abs-error calibration report at load")
 @click.option("--top_k", default=25, help="default per-request top_k")
 @click.option("--temperature", default=1.0,
               help="default per-request temperature")
@@ -161,8 +175,8 @@ def _build(checkpoint_path, max_slots, max_len, max_queue):
 @click.option("--prom_port", default=0,
               help="serve Prometheus text exposition over HTTP on this "
                    "localhost port (0 = off)")
-def main(checkpoint_path, max_slots, max_queue, max_len, top_k,
-         temperature, top_p, seed, socket_path, metrics_every,
+def main(checkpoint_path, max_slots, max_queue, max_len, quantize_int8,
+         top_k, temperature, top_p, seed, socket_path, metrics_every,
          prom_file, prom_port):
     from progen_tpu.telemetry import (
         prometheus_text,
@@ -171,7 +185,8 @@ def main(checkpoint_path, max_slots, max_queue, max_len, top_k,
     )
     from progen_tpu.tracking import make_tracker
 
-    sched, engine = _build(checkpoint_path, max_slots, max_len, max_queue)
+    sched, engine = _build(checkpoint_path, max_slots, max_len, max_queue,
+                           quantize_int8=quantize_int8)
     defaults = {
         "length": engine.max_len, "top_k": top_k,
         "temperature": temperature, "top_p": top_p, "seed": seed,

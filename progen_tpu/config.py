@@ -57,6 +57,17 @@ class ProGenConfig:
     # measured policy table (ops/pallas_policy.json) decide; any explicit
     # value >= 1 — including 1 = one window per program — overrides it.
     pallas_bh_block: int = 0
+    # Fuse the ScaleNorm+token-shift block heads and the SGU
+    # norm+mix+gate tail into single Pallas passes (ops/pallas_layers.py)
+    # instead of the separate XLA ops. Training/scoring path only (decode
+    # keeps the cached unfused ops); same params tree either way, so
+    # checkpoints interchange across the flag.
+    use_fused_layer_kernels: bool = False
+    # Sequence row-tile for the fused layer kernels. 0 (the default) lets
+    # the measured layer policy (pallas_policy.json "layer_entries")
+    # decide; an explicit value >= 1 forces the kernel at that tile
+    # (shrunk if needed to divide seq_len / fit VMEM).
+    pallas_layer_block: int = 0
     # Use the EXPLICIT ring halo-exchange attention (parallel/ring_attention)
     # instead of letting GSPMD infer the halo collectives. Takes effect only
     # when the model is built with a mesh whose ``seq`` axis is > 1

@@ -46,15 +46,45 @@ def _cached_shift(module: nn.Module, x: jnp.ndarray) -> jnp.ndarray:
     return shifted
 
 
+class _NormScale(nn.Module):
+    """Parameter-only twin of ScaleNorm's inner nn.LayerNorm: same module
+    name ("norm"), same param ("scale": ones init, ("embed",) logical
+    partitioning, param_dtype) but NO compute — the fused layer kernels
+    (ops/pallas_layers.py) normalize in-register and only need the scale
+    vector. Because the param path and metadata are identical, checkpoints
+    interchange freely across config.use_fused_layer_kernels."""
+
+    features: int
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self):
+        return self.param(
+            "scale",
+            nn.with_logical_partitioning(nn.initializers.ones, ("embed",)),
+            (self.features,),
+            self.param_dtype,
+        )
+
+
 class ScaleNorm(nn.Module):
-    """Scale-only LayerNorm (hk.LayerNorm(create_scale=True, create_offset=False))."""
+    """Scale-only LayerNorm (hk.LayerNorm(create_scale=True, create_offset=False)).
+
+    ``scale_only=True`` returns the scale PARAM instead of normalizing —
+    the handle the fused Pallas paths use; only one of the two branches
+    ever runs for a given (static) config, so the "norm" name is bound
+    exactly once either way."""
 
     epsilon: float = 1e-5
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, scale_only: bool = False):
+        if scale_only:
+            return _NormScale(
+                x.shape[-1], self.param_dtype, name="norm"
+            )()
         return nn.LayerNorm(
             epsilon=self.epsilon,
             use_bias=False,
@@ -66,6 +96,42 @@ class ScaleNorm(nn.Module):
             ),
             name="norm",
         )(x)
+
+
+def _fused_layer_ok(c: ProGenConfig) -> bool:
+    """The fused layer kernels apply only on the full-sequence path (the
+    decode cache keeps the unfused ops) and only when the pallas API
+    family is importable — the same degrade-don't-fail contract as
+    use_pallas_attn, so a config shipping use_fused_layer_kernels=true
+    stays runnable anywhere."""
+    if not (c.use_fused_layer_kernels and not c.decode):
+        return False
+    from progen_tpu.ops.pallas_layers import LAYER_PALLAS_OK
+
+    return LAYER_PALLAS_OK
+
+
+def _norm_shift_head(module: nn.Module, x: jnp.ndarray) -> jnp.ndarray:
+    """The pre-LN + token-shift head shared by the attention and FF
+    blocks. With config.use_fused_layer_kernels the two ops run as ONE
+    policy-dispatched Pallas pass (ops/pallas_layers.py); the norm's
+    scale param is created through the same ScaleNorm module path either
+    way, so the params tree is identical across the flag."""
+    c = module.config
+    norm = ScaleNorm(c.layer_norm_epsilon, c.compute_dtype, c.params_dtype)
+    if c.shift_tokens and _fused_layer_ok(c):
+        from progen_tpu.ops.pallas_layers import norm_shift
+
+        return norm_shift(
+            x, norm(x, scale_only=True),
+            c.layer_norm_epsilon, c.compute_dtype,
+            block_override=c.pallas_layer_block,
+            interpret=jax.default_backend() not in ("tpu", "axon"),
+        )
+    x = norm(x)
+    if c.shift_tokens:
+        x = _cached_shift(module, x) if c.decode else shift_tokens(x)
+    return x
 
 
 class LocalAttentionBlock(nn.Module):
@@ -86,9 +152,7 @@ class LocalAttentionBlock(nn.Module):
         b, n, _ = x.shape
         h, dh, w = c.heads, c.dim_head, c.window_size
 
-        x = ScaleNorm(c.layer_norm_epsilon, c.compute_dtype, c.params_dtype)(x)
-        if c.shift_tokens:
-            x = _cached_shift(self, x) if c.decode else shift_tokens(x)
+        x = _norm_shift_head(self, x)
 
         qkv = nn.Dense(
             3 * c.inner_dim,
@@ -274,7 +338,14 @@ class SpatialGatingUnit(nn.Module):
         )
         x, gate = jnp.split(x, 2, axis=-1)
 
-        gate = ScaleNorm(c.layer_norm_epsilon, c.compute_dtype, c.params_dtype)(gate)
+        norm = ScaleNorm(c.layer_norm_epsilon, c.compute_dtype, c.params_dtype)
+        fused = _fused_layer_ok(c)
+        # the fused tail normalizes the gate in-kernel; every other path
+        # (incl. decode's gate_history, which stores NORMALIZED gates)
+        # normalizes here
+        gate_scale = norm(gate, scale_only=True) if fused else None
+        if not fused:
+            gate = norm(gate)
 
         init_scale = c.sgu_init_eps / n
 
@@ -322,11 +393,21 @@ class SpatialGatingUnit(nn.Module):
                     biases.astype(jnp.float32), pos, axis=0, keepdims=False
                 )
                 gate = mixed[:, None, :].astype(x.dtype)
+                x = x * gate
+            elif fused:
+                from progen_tpu.ops.pallas_layers import sgu_mix_gate
+
+                x = sgu_mix_gate(
+                    x, gate, weights, biases, gate_scale,
+                    c.layer_norm_epsilon, c.compute_dtype,
+                    block_override=c.pallas_layer_block,
+                    interpret=jax.default_backend() not in ("tpu", "axon"),
+                )
             else:
                 gate = causal_sgu_mix(
                     gate, weights, biases, c.sgu_block_size
                 ).astype(x.dtype)
-            x = x * gate
+                x = x * gate
         return nn.Dense(
             self.dim_out,
             dtype=c.compute_dtype,
@@ -352,9 +433,7 @@ class FeedForwardBlock(nn.Module):
         )
         hidden = c.dim * c.ff_mult * (2 if self.glu else 1)
 
-        x = ScaleNorm(c.layer_norm_epsilon, c.compute_dtype, c.params_dtype)(x)
-        if c.shift_tokens:
-            x = _cached_shift(self, x) if c.decode else shift_tokens(x)
+        x = _norm_shift_head(self, x)
 
         x = nn.Dense(
             hidden,

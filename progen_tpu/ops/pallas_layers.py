@@ -1,0 +1,485 @@
+"""Fused Pallas TPU kernels for the gMLP layer's non-attention hot path.
+
+The layer today launches pre-norm, token-shift, the SGU norm, the causal
+spatial mix, and the multiplicative gate as SEPARATE XLA ops
+(ops/shift.py + ops/sgu.py composed in models/layers.py), each paying
+its own HBM round-trip over the full (batch, n, dim) activation. Two
+kernels close that gap:
+
+  * ``fused_norm_shift`` — the ``ScaleNorm -> shift_tokens`` head shared
+    by the attention and FF blocks, in ONE pass: each program normalizes
+    its (block, d) row-tile in f32 (flax LayerNorm replica: scale-only,
+    f32 stats, biased variance via E[x^2]-E[x]^2 clamped at 0),
+    normalizes the single halo row it needs from the previous block (a
+    second BlockSpec over the same array, row granularity — no HBM
+    duplication), shifts the whole tile down one row, and keeps the
+    shifted values only in the first ``d - d//2`` lanes (the split
+    ``shift_tokens`` applies). Program 0's halo row is zeroed
+    in-register, reproducing the reference's zero pad.
+
+  * ``fused_sgu_mix_gate`` — the SpatialGatingUnit tail
+    (``ScaleNorm(gate) -> causal mix -> x * gate``) with the gate's
+    output tile resident in VMEM across all three. Grid (batch, rows i,
+    cols j) with j the reduction ("arbitrary") dimension: the structural
+    zeros the recursive ``_block_triangular_mix`` skips by calling
+    ``_dense_mix`` on ever-smaller sub-triangles are skipped INSIDE the
+    kernel instead — ``@pl.when(j <= i)`` makes the strictly-upper
+    blocks true no-ops, and only the diagonal block pays a tril mask.
+    The gate block is normalized in-register right before it feeds the
+    MXU (round-tripped through the compute dtype so bf16 parity with the
+    unfused norm-then-mix holds bit-for-bit), accumulation is an f32
+    VMEM scratch, and the final j applies bias + ``x * gate`` before the
+    (1, block, d) output tile is written once.
+
+Both are ``jax.custom_vjp``: the backward differentiates the XLA
+reference composition (``norm_shift_reference`` /
+``sgu_mix_gate_reference``) on the saved primal inputs — the same
+escape-hatch structure as pallas_attention's ``bwd_impl="xla"``, and
+the right default here because both ops are bandwidth-bound enough that
+the fused forward is where the win lives.
+
+Impl selection mirrors the attention policy: ``layer_entries`` in the
+same pallas_policy.json, keyed (kind, n, d), written by bench.py's
+``kernel-fused-w*`` phases and read via ``measured_layer_impl``.
+
+VMEM at block=256, d=1024, f32: SGU acc + normalized gate 2 MB + the
+(256, 256) weight tile 0.25 MB; norm-shift holds one (256, d) tile.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import math
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from progen_tpu.ops.pallas_attention import _CompilerParams, _POLICY_PATH
+from progen_tpu.ops.sgu import causal_sgu_mix
+from progen_tpu.ops.shift import shift_tokens
+
+# Strictly weaker capability gate than the attention kernel's
+# PALLAS_API_OK: these kernels need CompilerParams but not ``jax.typeof``
+# (the vma declaration below degrades to a plain ShapeDtypeStruct on jax
+# versions that predate shard_map's check_vma), so the interpret-mode
+# parity tests run on the older pins too.
+LAYER_PALLAS_OK = _CompilerParams is not None
+
+
+def _out_struct(shape, dtype, like):
+    """ShapeDtypeStruct for pallas_call outputs: carries ``like``'s
+    varying-mesh-axes type where jax has one (see pallas_attention._sds),
+    plain otherwise."""
+    if hasattr(jax, "typeof"):
+        return jax.ShapeDtypeStruct(
+            shape, dtype, vma=getattr(jax.typeof(like), "vma", None)
+        )
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+# --------------------------------------------------------------------------
+# XLA reference compositions — the exact unfused math (flax LayerNorm with
+# use_bias=False + ops/shift.py + ops/sgu.py), used as the fallback
+# forward, the custom-VJP backward, and the parity golden in tests.
+
+
+def norm_reference(x, scale, epsilon, out_dtype):
+    """Scale-only LayerNorm over the last axis, replicating flax
+    ``nn.LayerNorm(use_bias=False)``: f32 stats, biased variance as
+    ``max(0, E[x^2] - E[x]^2)``, the rsqrt*scale product formed first."""
+    f32 = jnp.float32
+    x32 = x.astype(f32)
+    mu = x32.mean(axis=-1, keepdims=True)
+    mu2 = (x32 * x32).mean(axis=-1, keepdims=True)
+    var = jnp.maximum(0.0, mu2 - mu * mu)
+    y = (x32 - mu) * (jax.lax.rsqrt(var + epsilon) * scale.astype(f32))
+    return y.astype(out_dtype)
+
+
+def norm_shift_reference(x, scale, epsilon, out_dtype):
+    """Unfused golden for ``fused_norm_shift``."""
+    return shift_tokens(norm_reference(x, scale, epsilon, out_dtype))
+
+
+def sgu_mix_gate_reference(x, gate, weights, biases, scale, epsilon,
+                           out_dtype):
+    """Unfused golden for ``fused_sgu_mix_gate``: normalize the gate,
+    dense causal mix (block_size=0 — the blocked recursion is the same
+    math reassociated), multiply into ``x``."""
+    g = norm_reference(gate, scale, epsilon, out_dtype)
+    g = causal_sgu_mix(g, weights, biases)
+    return x * g.astype(x.dtype)
+
+
+# --------------------------------------------------------------------------
+# Kernels.
+
+
+def _norm_rows(x32, scale32, epsilon):
+    """The flax-replica normalization on an f32 (rows, d) tile."""
+    mu = x32.mean(axis=-1, keepdims=True)
+    mu2 = (x32 * x32).mean(axis=-1, keepdims=True)
+    var = jnp.maximum(0.0, mu2 - mu * mu)
+    return (x32 - mu) * (jax.lax.rsqrt(var + epsilon) * scale32)
+
+
+def _norm_shift_kernel(x_ref, prev_ref, s_ref, o_ref, *, epsilon, split):
+    f32 = jnp.float32
+    scale = s_ref[...].astype(f32)  # (1, d), broadcasts over rows
+    y = _norm_rows(x_ref[0].astype(f32), scale, epsilon)  # (bn, d)
+    # the halo: the previous block's LAST row, normalized here rather
+    # than re-read from the neighbor's output (programs are independent);
+    # program 0 reads its own row 0 through the clamped index map and
+    # masks it to the reference's zero pad
+    prev = _norm_rows(prev_ref[0].astype(f32), scale, epsilon)  # (1, d)
+    prev = prev * (pl.program_id(1) > 0).astype(f32)
+    shifted = jnp.concatenate([prev, y[:-1, :]], axis=0)
+    col = jax.lax.broadcasted_iota(jnp.int32, y.shape, 1)
+    out = jnp.where(col < split, shifted, y)
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+def _sgu_kernel(x_ref, g_ref, w_ref, b_ref, s_ref, o_ref, acc_ref, *,
+                epsilon):
+    f32 = jnp.float32
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _zero():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(j <= i)
+    def _accumulate():
+        # normalize the gate tile right before it feeds the MXU; the
+        # round-trip through the output dtype replicates the unfused
+        # path's bf16 rounding between the norm and the mix
+        g = _norm_rows(g_ref[0].astype(f32), s_ref[...].astype(f32),
+                       epsilon)
+        g = g.astype(o_ref.dtype).astype(f32)
+        w = w_ref[...].astype(f32)  # (bn out-rows, bn in-cols)
+        # strictly-lower blocks (j < i) are fully causal; only the
+        # diagonal block pays the tril mask. j > i never runs — that is
+        # _block_triangular_mix's structural-zero skip, in-kernel.
+        row = jax.lax.broadcasted_iota(jnp.int32, w.shape, 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, w.shape, 1)
+        w = jnp.where((j < i) | (col <= row), w, 0.0)
+        acc_ref[...] += jax.lax.dot_general(
+            w, g,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=f32,
+        )
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _finalize():
+        gate = (acc_ref[...] + b_ref[...].astype(f32)).astype(o_ref.dtype)
+        o_ref[0] = x_ref[0] * gate
+
+
+# --------------------------------------------------------------------------
+# pallas_call wrappers + custom VJPs. ``out_dtype`` rides as a STRING so
+# the nondiff args stay hashable under jit.
+
+
+def _norm_shift_pallas(x, scale, epsilon, block, interpret, out_dtype):
+    b, n, d = x.shape
+    bn = block
+    scale2 = scale.reshape(1, d)
+    grid = (b, n // bn)
+    return pl.pallas_call(
+        functools.partial(
+            _norm_shift_kernel, epsilon=epsilon, split=d - d // 2
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bn, d), lambda bi, i: (bi, i, 0),
+                         memory_space=pltpu.VMEM),
+            # row-granular halo spec over the SAME array: element row
+            # i*bn - 1 (the previous block's last row), clamped at 0
+            pl.BlockSpec(
+                (1, 1, d),
+                lambda bi, i: (bi, jnp.maximum(i * bn - 1, 0), 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec((1, d), lambda bi, i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, bn, d), lambda bi, i: (bi, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=_out_struct((b, n, d), jnp.dtype(out_dtype), x),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel")
+        ),
+        interpret=interpret,
+    )(x, x, scale2)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def fused_norm_shift(x, scale, epsilon, block, interpret, out_dtype):
+    """Fused ScaleNorm + token-shift. ``x``: (batch, n, d); ``scale``:
+    (d,) norm scale param; ``block`` row-tile must divide n. Returns
+    (batch, n, d) in ``out_dtype`` (pass a dtype NAME — nondiff args
+    must hash). Backward differentiates ``norm_shift_reference``."""
+    out, _ = _norm_shift_fwd(x, scale, epsilon, block, interpret,
+                             out_dtype)
+    return out
+
+
+def _norm_shift_fwd(x, scale, epsilon, block, interpret, out_dtype):
+    return (
+        _norm_shift_pallas(x, scale, epsilon, block, interpret, out_dtype),
+        (x, scale),
+    )
+
+
+def _norm_shift_bwd(epsilon, block, interpret, out_dtype, res, g):
+    x, scale = res
+
+    def ref(x_, s_):
+        return norm_shift_reference(x_, s_, epsilon, out_dtype)
+
+    _, vjp = jax.vjp(ref, x, scale)
+    return vjp(g)
+
+
+fused_norm_shift.defvjp(_norm_shift_fwd, _norm_shift_bwd)
+
+
+def _sgu_pallas(x, gate, weights, biases, scale, epsilon, block, interpret,
+                out_dtype):
+    b, n, d = gate.shape
+    bn = block
+    nb = n // bn
+    scale2 = scale.reshape(1, d)
+    return pl.pallas_call(
+        functools.partial(_sgu_kernel, epsilon=epsilon),
+        grid=(b, nb, nb),
+        in_specs=[
+            pl.BlockSpec((1, bn, d), lambda bi, i, j: (bi, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bn, d), lambda bi, i, j: (bi, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bn, bn), lambda bi, i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bn, 1), lambda bi, i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, d), lambda bi, i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        # j-independent output tile: stays VMEM-resident across the whole
+        # j reduction, flushed to HBM once when (bi, i) advances
+        out_specs=pl.BlockSpec((1, bn, d), lambda bi, i, j: (bi, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=_out_struct((b, n, d), jnp.dtype(out_dtype), gate),
+        scratch_shapes=[pltpu.VMEM((bn, d), jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=b * n * n * d,  # causal half of 2*b*n*n*d
+            transcendentals=0,
+            bytes_accessed=4 * b * n * d * 2 + 4 * n * n,
+        ),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(x, gate, weights, biases, scale2)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def fused_sgu_mix_gate(x, gate, weights, biases, scale, epsilon, block,
+                       interpret, out_dtype):
+    """Fused SGU tail: ScaleNorm(gate) -> causal spatial mix -> x * gate.
+    ``x``/``gate``: (batch, n, d) halves of the FF hidden; ``weights``:
+    (n, n); ``biases``: (n, 1); ``scale``: (d,). ``block`` must divide
+    n. Backward differentiates ``sgu_mix_gate_reference``."""
+    out, _ = _sgu_fwd(x, gate, weights, biases, scale, epsilon, block,
+                      interpret, out_dtype)
+    return out
+
+
+def _sgu_fwd(x, gate, weights, biases, scale, epsilon, block, interpret,
+             out_dtype):
+    out = _sgu_pallas(x, gate, weights, biases, scale, epsilon, block,
+                      interpret, out_dtype)
+    return out, (x, gate, weights, biases, scale)
+
+
+def _sgu_bwd(epsilon, block, interpret, out_dtype, res, g):
+    x, gate, weights, biases, scale = res
+
+    def ref(x_, g_, w_, b_, s_):
+        return sgu_mix_gate_reference(x_, g_, w_, b_, s_, epsilon,
+                                      out_dtype)
+
+    _, vjp = jax.vjp(ref, x, gate, weights, biases, scale)
+    return vjp(g)
+
+
+fused_sgu_mix_gate.defvjp(_sgu_fwd, _sgu_bwd)
+
+
+# --------------------------------------------------------------------------
+# Measured layer policy: ``layer_entries`` in the same pallas_policy.json
+# the attention table lives in (record_policy_entry there only rewrites
+# "entries", so the two tables coexist). Keyed (kind, n, d); written by
+# bench.py's kernel-fused-w* phases, read at layer trace time.
+
+_LAYER_ENTRY_KEYS = ("kind", "n", "d", "impl", "block")
+
+_LAYER_KINDS = ("norm_shift", "sgu_mix")
+
+# Unmeasured defaults: the fused kernels exist to cut HBM round-trips, so
+# until a kernel-fused-w* phase records on-chip numbers the opt-in flag
+# gets the kernel at the attention bench's proven-good tile size. Marked
+# via provenance in the seeded JSON; bench re-measurement replaces them.
+_LAYER_FALLBACK_ENTRIES = (
+    {"kind": "norm_shift", "n": 1024, "d": 512, "impl": "pallas",
+     "block": 256},
+    {"kind": "sgu_mix", "n": 1024, "d": 1024, "impl": "pallas",
+     "block": 256},
+)
+
+
+def _layer_entries(path: Path | None = None) -> list[dict]:
+    path = path or _POLICY_PATH
+
+    def _valid(e: dict) -> bool:
+        try:
+            return (
+                all(k in e for k in _LAYER_ENTRY_KEYS)
+                and e["kind"] in _LAYER_KINDS
+                and all(
+                    isinstance(e[k], (int, float)) and e[k] > 0
+                    for k in ("n", "d")
+                )
+                and isinstance(e["block"], int) and e["block"] >= 1
+                and e["impl"] in ("pallas", "xla")
+            )
+        except TypeError:
+            return False
+
+    try:
+        doc = json.loads(path.read_text())
+        entries = [e for e in doc.get("layer_entries", []) if _valid(e)]
+        if entries:
+            return entries
+    except (OSError, ValueError):
+        pass
+    return list(_LAYER_FALLBACK_ENTRIES)
+
+
+def layer_policy_decision(kind: str, n: int, d: int,
+                          path: Path | None = None) -> dict:
+    """Measured-winner entry for ``kind`` nearest to (n, d) in log-space
+    (n dominates: the mix is O(n^2) while d only widens the tiles),
+    annotated like the attention table's policy_decision."""
+    if kind not in _LAYER_KINDS:
+        raise ValueError(f"unknown layer kernel kind {kind!r}")
+    entries = [e for e in _layer_entries(path) if e["kind"] == kind]
+    if not entries:
+        entries = [e for e in _LAYER_FALLBACK_ENTRIES if e["kind"] == kind]
+
+    def dist(e: dict) -> float:
+        return (
+            2.0 * abs(math.log2(n / e["n"]))
+            + abs(math.log2(d / e["d"]))
+        )
+
+    best = min(entries, key=dist)
+    exact = best["n"] == n and best["d"] == d
+    return {
+        **best,
+        "exact_shape_match": exact,
+        "requested": {"kind": kind, "n": n, "d": d},
+    }
+
+
+def measured_layer_impl(kind: str, n: int, d: int) -> tuple[str, int]:
+    """(impl, block) from the layer policy table for the given shape."""
+    e = layer_policy_decision(kind, n, d)
+    return e["impl"], e["block"]
+
+
+def record_layer_policy_entry(entry: dict, path: Path | None = None) -> None:
+    """Merge one measured layer-kernel winner into ``layer_entries``,
+    preserving every other top-level key (notably the attention table's
+    "entries") — the mirror of record_policy_entry's contract."""
+    missing = [k for k in _LAYER_ENTRY_KEYS if k not in entry]
+    if missing:
+        raise ValueError(f"layer policy entry missing keys {missing}")
+    path = path or _POLICY_PATH
+    try:
+        doc = json.loads(path.read_text())
+        assert isinstance(doc, dict)
+    except (OSError, ValueError, AssertionError):
+        doc = {"schema": "pallas-policy-v1", "entries": []}
+    doc.setdefault("layer_entries", [])
+    key = lambda e: (e["kind"], e["n"], e["d"])
+    kept = [
+        e for e in doc["layer_entries"]
+        if all(k in e for k in ("kind", "n", "d")) and key(e) != key(entry)
+    ]
+    doc["layer_entries"] = sorted(kept + [entry], key=key)
+    tmp = path.with_suffix(".json.tmp")
+    tmp.write_text(json.dumps(doc, indent=1))
+    tmp.replace(path)
+
+
+# --------------------------------------------------------------------------
+# Dispatch entry points for models/layers.py.
+
+
+def safe_layer_block(block: int, n: int, d: int) -> int | None:
+    """Largest usable row-tile <= block: divides n, >= 8 rows (the f32
+    sublane tile), and keeps the fused-SGU working set (f32 acc + gate
+    tile + (bn, bn) f32 weight tile) within ~8 MB of VMEM. None when no
+    tile qualifies — callers fall back to the XLA reference."""
+    bn = min(max(1, int(block)), n)
+    while bn >= 8:
+        if n % bn == 0 and (bn * d * 8 + bn * bn * 4) <= (8 << 20):
+            return bn
+        bn -= 1
+    return None
+
+
+def _resolve(kind: str, n: int, d: int, block_override: int):
+    impl, blk = measured_layer_impl(kind, n, d)
+    if block_override:
+        impl, blk = "pallas", int(block_override)
+    return impl, safe_layer_block(blk, n, d)
+
+
+def norm_shift(x, scale, epsilon, out_dtype, *, block_override: int = 0,
+               interpret: bool = False):
+    """Policy-dispatched fused norm+shift; falls back to the XLA
+    reference (plain autodiff, no VJP indirection) off-policy or when no
+    legal tile exists. ``block_override`` (config.pallas_layer_block)
+    forces the kernel at that tile."""
+    dt = jnp.dtype(out_dtype).name
+    if x.ndim != 3 or x.shape[-1] < 2:
+        return norm_shift_reference(x, scale, epsilon, dt)
+    impl, blk = _resolve("norm_shift", x.shape[-2], x.shape[-1],
+                         block_override)
+    if impl != "pallas" or blk is None:
+        return norm_shift_reference(x, scale, epsilon, dt)
+    return fused_norm_shift(x, scale, epsilon, blk, interpret, dt)
+
+
+def sgu_mix_gate(x, gate, weights, biases, scale, epsilon, out_dtype, *,
+                 block_override: int = 0, interpret: bool = False):
+    """Policy-dispatched fused SGU tail; same fallback contract as
+    ``norm_shift``."""
+    dt = jnp.dtype(out_dtype).name
+    if gate.ndim != 3:
+        return sgu_mix_gate_reference(x, gate, weights, biases, scale,
+                                      epsilon, dt)
+    impl, blk = _resolve("sgu_mix", gate.shape[-2], gate.shape[-1],
+                         block_override)
+    if impl != "pallas" or blk is None:
+        return sgu_mix_gate_reference(x, gate, weights, biases, scale,
+                                      epsilon, dt)
+    return fused_sgu_mix_gate(x, gate, weights, biases, scale, epsilon,
+                              blk, interpret, dt)

@@ -33,12 +33,14 @@ tests/test_serving.py.
 from __future__ import annotations
 
 import functools
+import logging
 from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from progen_tpu.ops.quant import dequantize_tree, quantize_tree
 from progen_tpu.sampling import (
     _TOP_P_OFF,
     _decode_setup,
@@ -46,6 +48,8 @@ from progen_tpu.sampling import (
     _validate_knobs,
     gumbel_step_dynamic,
 )
+
+logger = logging.getLogger(__name__)
 
 
 class SlotBatch(NamedTuple):
@@ -65,8 +69,7 @@ class SlotBatch(NamedTuple):
     live: jnp.ndarray  # (S,) bool slot is decoding
 
 
-@functools.partial(jax.jit, static_argnames=("model",))
-def _prefill(
+def _prefill_impl(
     model,
     params,
     slots: SlotBatch,
@@ -85,7 +88,8 @@ def _prefill(
     batch-1 cache (positions 0..start-2; a dynamic-bound fori_loop, so
     one compile serves every prime length) and scatter the cache + all
     per-slot state into the pool. ``slot``/``start``/``target`` are
-    traced, keeping this a single compiled program."""
+    traced, keeping this a single compiled program. Un-jitted body shared
+    by the bf16 and int8 entry points below."""
     length = slots.seqs.shape[1]
 
     def feed(p, cache):
@@ -125,15 +129,44 @@ def _prefill(
     )
 
 
-@functools.partial(jax.jit, static_argnames=("model",))
-def _decode_step(model, params, slots: SlotBatch):
+@functools.partial(
+    jax.jit, static_argnames=("model",), donate_argnums=(2,)
+)
+def _prefill(model, params, slots, fresh_cache, slot, tokens, start,
+             target, key, temp, top_p, top_k, parity):
+    """Jitted bf16/f32 prefill. The pool (``slots``, arg 2) is DONATED:
+    every leaf is rebuilt each call and the caller immediately rebinds
+    ``self.slots`` to the result, so the old buffers alias the new ones
+    instead of doubling the pool's HBM footprint. ``fresh_cache`` is NOT
+    donated — it is the reusable zero template."""
+    return _prefill_impl(model, params, slots, fresh_cache, slot, tokens,
+                         start, target, key, temp, top_p, top_k, parity)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("model",), donate_argnums=(3,)
+)
+def _prefill_q(model, q_params, scales, slots, fresh_cache, slot, tokens,
+               start, target, key, temp, top_p, top_k, parity):
+    """Int8 prefill: dequantize the per-channel int8 kernels on-device
+    (XLA fuses convert+scale into each consuming matmul) and delegate.
+    ``slots`` is arg 3 here, donated for the same reason as _prefill."""
+    params = dequantize_tree(
+        q_params, scales, model.config.compute_dtype
+    )
+    return _prefill_impl(model, params, slots, fresh_cache, slot, tokens,
+                         start, target, key, temp, top_p, top_k, parity)
+
+
+def _decode_step_impl(model, params, slots: SlotBatch):
     """Advance ALL slots one token: vmapped batch-1 apply over the slot
     axis, per-slot dynamic Gumbel draw, masked scatter-back. Dead slots
     compute too (their writes are masked out) — the price of a single
     static-shape program, and exactly what keeps a TPU from recompiling
     as traffic churns. Returns (new_slots, sampled, was_live, finished);
     ``finished`` flags slots that JUST hit EOS (second zero) or their
-    requested length this step."""
+    requested length this step. Un-jitted body shared by the bf16 and
+    int8 entry points below."""
     n_slots, length = slots.seqs.shape
     pos = jnp.clip(slots.cur, 0, length - 1)
     toks = jnp.take_along_axis(slots.seqs, pos[:, None], axis=1)[:, :, None]
@@ -172,13 +205,39 @@ def _decode_step(model, params, slots: SlotBatch):
     return new, sampled, slots.live, finished
 
 
+@functools.partial(
+    jax.jit, static_argnames=("model",), donate_argnums=(2,)
+)
+def _decode_step(model, params, slots):
+    """Jitted bf16/f32 decode step. ``slots`` (arg 2) is DONATED — the
+    hot-loop fix the PGL003 audit asked for: every decode step rebuilds
+    the full pool (cache + per-slot state) and the caller rebinds
+    ``self.slots``, so without donation the engine held two copies of
+    the (max_slots, 2w) K/V pool across every step."""
+    return _decode_step_impl(model, params, slots)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("model",), donate_argnums=(3,)
+)
+def _decode_step_q(model, q_params, scales, slots):
+    """Int8 decode step: per-channel dequant fused into the matmuls,
+    then the shared body. ``slots`` is arg 3, donated as above; the int8
+    weights themselves are never donated (read every step)."""
+    params = dequantize_tree(
+        q_params, scales, model.config.compute_dtype
+    )
+    return _decode_step_impl(model, params, slots)
+
+
 class ServeEngine:
     """Fixed-pool continuous-batching engine bound to one (model, params,
     max_slots, max_len). Host-side it is just a free-list and two jitted
     calls; all decode state lives on the device in ``self.slots``."""
 
     def __init__(self, model, params, *, max_slots: int = 8,
-                 max_len: Optional[int] = None):
+                 max_len: Optional[int] = None,
+                 quantize_int8: bool = False):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         self.max_len = int(max_len or model.config.seq_len)
@@ -213,6 +272,57 @@ class ServeEngine:
         )
         self._free = list(range(s))
         self._targets = [l] * s  # host mirror for collect()
+        self.quantize_int8 = bool(quantize_int8)
+        self.quant_report = None
+        self._q_params = self._q_scales = None
+        if self.quantize_int8:
+            self._q_params, self._q_scales, leaves = quantize_tree(
+                self.params
+            )
+            self.quant_report = self._calibrate(leaves)
+
+    def _calibrate(self, leaves: list) -> dict:
+        """The logged accuracy contract of the int8 path: per-leaf weight
+        max-abs-error from quantize_tree plus the worst logits
+        max-abs-error of the dequantized weights vs the full-precision
+        path over a fixed calibration prompt through a fresh cache (the
+        exact op sequence decode runs)."""
+        deq = dequantize_tree(
+            self._q_params, self._q_scales, self.model.config.compute_dtype
+        )
+        cache_a = cache_b = self.fresh_cache
+        worst = 0.0
+        for tok in (1, 7, 23, 4):  # fixed calibration prompt
+            t = jnp.full((1, 1), tok, jnp.int32)
+            la, mut_a = self.model.apply(
+                {"params": self.params, "cache": cache_a}, t,
+                mutable=["cache"],
+            )
+            cache_a = mut_a["cache"]
+            lb, mut_b = self.model.apply(
+                {"params": deq, "cache": cache_b}, t, mutable=["cache"]
+            )
+            cache_b = mut_b["cache"]
+            worst = max(worst, float(jnp.max(jnp.abs(
+                la.astype(jnp.float32) - lb.astype(jnp.float32)
+            ))))
+        report = {
+            "bits": 8,
+            "scheme": "per-channel symmetric, weights only",
+            "quantized_leaves": len(leaves),
+            "bytes_fp": sum(leaf["bytes_fp"] for leaf in leaves),
+            "bytes_int8": sum(leaf["bytes_int8"] for leaf in leaves),
+            "weight_max_abs_err": max(
+                (leaf["max_abs_err"] for leaf in leaves), default=0.0
+            ),
+            "logits_max_abs_err": worst,
+            "leaves": leaves,
+        }
+        logger.info(
+            "int8 calibration: %s",
+            {k: v for k, v in report.items() if k != "leaves"},
+        )
+        return report
 
     # ----- slot lifecycle -------------------------------------------------
 
@@ -281,8 +391,7 @@ class ServeEngine:
         if key is None:
             key = jax.random.PRNGKey(seed)
         parity = temperature == 1.0 and top_p is None
-        self.slots = _prefill(
-            self.model, self.params, self.slots, self.fresh_cache,
+        tail = (
             jnp.int32(slot), jnp.asarray(row), jnp.int32(start),
             jnp.int32(length), key,
             jnp.float32(temperature),
@@ -290,6 +399,16 @@ class ServeEngine:
             jnp.int32(0 if top_k is None else top_k),
             jnp.asarray(parity),
         )
+        if self.quantize_int8:
+            self.slots = _prefill_q(
+                self.model, self._q_params, self._q_scales, self.slots,
+                self.fresh_cache, *tail,
+            )
+        else:
+            self.slots = _prefill(
+                self.model, self.params, self.slots, self.fresh_cache,
+                *tail,
+            )
         self._targets[slot] = int(length)
         return int(start)
 
@@ -299,9 +418,14 @@ class ServeEngine:
         """One token for every live slot. Returns host arrays
         (sampled, was_live, finished), each (max_slots,) — ``sampled[i]``
         is meaningful only where ``was_live[i]``."""
-        self.slots, sampled, was_live, finished = _decode_step(
-            self.model, self.params, self.slots
-        )
+        if self.quantize_int8:
+            self.slots, sampled, was_live, finished = _decode_step_q(
+                self.model, self._q_params, self._q_scales, self.slots
+            )
+        else:
+            self.slots, sampled, was_live, finished = _decode_step(
+                self.model, self.params, self.slots
+            )
         return (
             np.asarray(sampled),
             np.asarray(was_live),

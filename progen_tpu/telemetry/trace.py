@@ -13,6 +13,12 @@ direct by design:
   * one-shot records (``retry``, ``anomaly``, ``stall``, ``chaos``,
     ``ckpt_commit_failed``, …) → instant events (``ph: "i"``) pinned to
     their host track;
+  * each ``retry`` additionally opens a flow arrow (``ph: "s"`` →
+    ``ph: "f"``, ``bp: "e"``) from the retry instant to the END of the
+    innermost span open on that host when it fired — the viewer draws
+    the line from the fault to the operation that absorbed its latency
+    (an IO retry inside ``ckpt/save`` visibly bills the save, not the
+    step). A retry outside any open span stays a bare instant;
   * ``goodput_host`` records and metrics.jsonl rows → counter tracks
     (``ph: "C"``): ``step_ms``, ``mfu``, ``tokens_per_sec_per_chip``,
     ``goodput_pct``, stacked ``goodput_bucket_s`` series, and the HBM
@@ -106,6 +112,13 @@ def build_trace(
     seen_pids: set = set()
     seen_tids: set = set()
     host_reports: dict = {}
+    # retry→absorbing-span flow state: per-pid stack of open spans in
+    # input order; a retry binds to the innermost one, and the matching
+    # flow-end lands when that span's E arrives. Spans that never close
+    # (crash mid-span) leave an s-only flow — viewers render the start
+    # arrowhead, which is the honest picture.
+    open_spans: dict = {}
+    flow_id = 0
 
     def _note_pid(pid: int) -> None:
         if pid not in seen_pids:
@@ -136,6 +149,22 @@ def build_trace(
                 "cat": "span", "ts": _us(ts), "pid": pid, "tid": tid,
                 "args": _args(rec),
             })
+            if ev == "B":
+                open_spans.setdefault(pid, []).append(
+                    {"tid": tid, "pending": []}
+                )
+            else:
+                stack = open_spans.get(pid) or []
+                for i in range(len(stack) - 1, -1, -1):
+                    if stack[i]["tid"] != tid:
+                        continue
+                    for fid in stack.pop(i)["pending"]:
+                        trace_events.append({
+                            "ph": "f", "bp": "e", "cat": "flow",
+                            "name": "retry_absorbed", "id": fid,
+                            "ts": _us(ts), "pid": pid, "tid": tid,
+                        })
+                    break
         elif ev == "goodput_host":
             host = int(rec.get("host", pid))
             _note_pid(host)
@@ -151,6 +180,17 @@ def build_trace(
                 "ts": _us(ts), "pid": pid, "tid": 0, "s": "p",
                 "args": _args(rec),
             })
+            if ev == "retry":
+                stack = open_spans.get(pid) or []
+                if stack:
+                    frame = stack[-1]
+                    flow_id += 1
+                    frame["pending"].append(flow_id)
+                    trace_events.append({
+                        "ph": "s", "cat": "flow",
+                        "name": "retry_absorbed", "id": flow_id,
+                        "ts": _us(ts), "pid": pid, "tid": frame["tid"],
+                    })
 
     for rec in metrics:
         ts = rec.get("_time")

@@ -1,0 +1,137 @@
+"""Bench regression gate over the BENCH_r0N.json trajectory.
+
+The repo's perf history is an append-only chain of per-round headline
+records (``BENCH_r0N.json``: ``{"n", "cmd", "rc", "tail", "parsed"}``
+with ``parsed`` the headline dict bench.py printed). MegaScale's
+discipline is that the SLO metric must never silently regress; this
+module turns the chain into a ratchet: the gate compares a freshly
+measured headline against the BEST prior round (not the latest — a bad
+round must not lower the bar for the next one) and fails when it drops
+more than a tolerance below it.
+
+Two metric chains live in the trajectory:
+
+  * ``tpu``  — real-chip ``train_tokens_per_sec_per_chip`` headlines,
+    plus the ``last_tpu_record`` carry that CPU-only rounds attach so
+    the on-chip record survives rounds without TPU access;
+  * ``cpu``  — the ``cpu_fallback_smoke_tokens_per_sec`` numbers every
+    round produces, which is what CI can enforce (tier1.yml runs the
+    gate on these; runner-to-runner variance is why its tolerance is
+    loose — the gate exists to catch the 2x cliff, not the 5% wobble).
+
+jax-free on purpose: CI and tests call this before (or without) any
+backend coming up, and tests/test_bench.py imports bench.py the same
+way.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
+from typing import Optional
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def load_trajectory(repo_root) -> list:
+    """Parsed trajectory records sorted by round number. Records that do
+    not parse (torn writes, nulls) are kept with ``parsed=None`` so
+    best_prior can skip them without hiding that the round happened."""
+    records = []
+    for path in Path(repo_root).glob("BENCH_r*.json"):
+        m = _ROUND_RE.search(path.name)
+        if not m:
+            continue
+        try:
+            doc = json.loads(path.read_text())
+        except (OSError, ValueError):
+            doc = {}
+        records.append({
+            "round": int(m.group(1)),
+            "path": str(path),
+            "parsed": doc.get("parsed")
+            if isinstance(doc.get("parsed"), dict) else None,
+        })
+    return sorted(records, key=lambda r: r["round"])
+
+
+def _candidates(records: list, metric: str):
+    """(value, round, carried) observations for one metric chain."""
+    for rec in records:
+        p = rec["parsed"]
+        if not p:
+            continue
+        name = str(p.get("metric", ""))
+        value = p.get("value")
+        if metric == "cpu":
+            if name == "cpu_fallback_smoke_tokens_per_sec" and value:
+                yield float(value), rec["round"], False
+        elif metric == "tpu":
+            if (
+                name.startswith("train_tokens")
+                and p.get("platform") == "tpu"
+                and value
+            ):
+                yield float(value), rec["round"], False
+            carry = p.get("last_tpu_record")
+            if isinstance(carry, dict) and carry.get("value"):
+                yield float(carry["value"]), rec["round"], True
+
+
+def best_prior(records: list, metric: str = "auto") -> Optional[dict]:
+    """The best observation on the requested chain, or None when the
+    chain is empty (first round: the gate passes and ESTABLISHES the
+    bar). ``metric="auto"`` prefers the tpu chain when it has any
+    observation — the real SLO — falling back to cpu."""
+    if metric == "auto":
+        return best_prior(records, "tpu") or best_prior(records, "cpu")
+    if metric not in ("cpu", "tpu"):
+        raise ValueError(f"unknown gate metric {metric!r}")
+    best = None
+    for value, rnd, carried in _candidates(records, metric):
+        if best is None or value > best["value"]:
+            best = {
+                "metric": metric, "value": value, "round": rnd,
+                "carried": carried,
+            }
+    return best
+
+
+def evaluate_gate(value: float, best: Optional[dict],
+                  tolerance: float) -> dict:
+    """Ratchet comparison: ``ok`` iff ``value`` is within ``tolerance``
+    (fractional drop) of the best prior value — or there is no prior."""
+    if not 0 <= tolerance < 1:
+        raise ValueError(f"tolerance must be in [0, 1), got {tolerance}")
+    if best is None:
+        return {
+            "ok": True, "value": value, "best": None, "floor": None,
+            "tolerance": tolerance,
+            "reason": "no prior rounds on this chain: value sets the bar",
+        }
+    floor = best["value"] * (1.0 - tolerance)
+    ok = value >= floor
+    return {
+        "ok": ok,
+        "value": value,
+        "best": best,
+        "floor": floor,
+        "ratio": value / best["value"] if best["value"] else None,
+        "tolerance": tolerance,
+        "reason": (
+            f"value {value:.1f} {'>=' if ok else '<'} floor {floor:.1f} "
+            f"({(1 - tolerance) * 100:.0f}% of round {best['round']}'s "
+            f"best {best['value']:.1f}"
+            f"{', carried TPU record' if best.get('carried') else ''})"
+        ),
+    }
+
+
+def run_gate(value: float, metric: str, tolerance: float,
+             repo_root) -> dict:
+    """load -> best -> evaluate, in one call (the bench.py ``gate``
+    subcommand's core; also what tests drive against synthetic
+    trajectories)."""
+    best = best_prior(load_trajectory(repo_root), metric)
+    return evaluate_gate(value, best, tolerance)

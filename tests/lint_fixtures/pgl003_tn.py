@@ -18,3 +18,16 @@ def rebound_each_iteration(state, batches):
 
 def donate_then_done(state, batch):
     return train_step(state, batch)  # no read after the call
+
+
+class _EngineLike:
+    """Serving-engine idiom: the donated buffer lives on the instance and
+    every call REBINDS the attribute to the jit's output before any
+    further read — the decode hot loop's pattern (serving/engine.py)."""
+
+    def __init__(self, state):
+        self.state = state
+
+    def step(self, batch):
+        self.state = train_step(self.state, batch)
+        return self.state

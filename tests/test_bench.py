@@ -383,3 +383,125 @@ class TestDetailGuard:
         bench._write_detail_guarded(smoke)
         kept = json.loads((tmp_path / "BENCH_DETAIL.json").read_text())
         assert kept == smoke
+
+
+class TestBenchGate:
+    """The ratchet over the BENCH_r0N.json trajectory
+    (progen_tpu/utils/bench_gate + the `bench.py gate` subcommand
+    tier1.yml enforces)."""
+
+    def _write(self, tmp_path, rnd, parsed):
+        import json
+
+        (tmp_path / f"BENCH_r{rnd:02d}.json").write_text(
+            json.dumps({"n": rnd, "parsed": parsed})
+        )
+
+    def _cpu_round(self, value, **extra):
+        return {"metric": "cpu_fallback_smoke_tokens_per_sec",
+                "value": value, "platform": "cpu", **extra}
+
+    def test_best_prior_is_max_not_latest(self, tmp_path):
+        from progen_tpu.utils.bench_gate import best_prior, load_trajectory
+
+        self._write(tmp_path, 1, None)  # torn round: kept, skipped
+        self._write(tmp_path, 2, self._cpu_round(40000.0))
+        self._write(tmp_path, 3, self._cpu_round(27000.0))
+        best = best_prior(load_trajectory(tmp_path), "cpu")
+        assert best["value"] == 40000.0 and best["round"] == 2
+
+    def test_tpu_chain_reads_carried_records(self, tmp_path):
+        from progen_tpu.utils.bench_gate import best_prior, load_trajectory
+
+        self._write(tmp_path, 2, {
+            "metric": "train_tokens_per_sec_per_chip",
+            "value": 180000.0, "platform": "tpu",
+        })
+        self._write(tmp_path, 3, self._cpu_round(
+            27000.0, last_tpu_record={"value": 206369.0}
+        ))
+        records = load_trajectory(tmp_path)
+        best = best_prior(records, "tpu")
+        assert best["value"] == 206369.0 and best["carried"]
+        # auto prefers the tpu chain over the cpu one
+        assert best_prior(records, "auto")["metric"] == "tpu"
+
+    def test_cpu_chain_never_reads_tpu_records(self, tmp_path):
+        from progen_tpu.utils.bench_gate import best_prior, load_trajectory
+
+        self._write(tmp_path, 2, {
+            "metric": "train_tokens_per_sec_per_chip",
+            "value": 180000.0, "platform": "tpu",
+        })
+        assert best_prior(load_trajectory(tmp_path), "cpu") is None
+
+    def test_evaluate_gate_ratchet(self):
+        from progen_tpu.utils.bench_gate import evaluate_gate
+
+        best = {"metric": "cpu", "value": 1000.0, "round": 2,
+                "carried": False}
+        assert evaluate_gate(900.0, best, 0.2)["ok"]
+        assert not evaluate_gate(700.0, best, 0.2)["ok"]
+        assert evaluate_gate(1.0, None, 0.2)["ok"]  # first round: sets bar
+        with pytest.raises(ValueError):
+            evaluate_gate(900.0, best, 1.5)
+
+    def test_unknown_metric_raises(self):
+        from progen_tpu.utils.bench_gate import best_prior
+
+        with pytest.raises(ValueError):
+            best_prior([], "mfu")
+
+    def test_gate_cli_exit_codes(self, bench, monkeypatch, tmp_path,
+                                 capsys):
+        self._write(tmp_path, 2, self._cpu_round(1000.0))
+        monkeypatch.setattr(bench, "_REPO", tmp_path)
+        args = ["--metric", "cpu", "--tolerance", "0.2"]
+        assert bench.gate_main(args + ["--value", "900"]) == 0
+        assert bench.gate_main(args + ["--value", "100"]) == 1
+        assert bench.gate_main(
+            args + ["--from-json", str(tmp_path / "missing.json")]
+        ) == 2
+        capsys.readouterr()
+
+    def test_gate_cli_from_json_forms(self, bench, monkeypatch, tmp_path,
+                                      capsys):
+        import json
+
+        self._write(tmp_path, 2, self._cpu_round(1000.0))
+        monkeypatch.setattr(bench, "_REPO", tmp_path)
+        bare = tmp_path / "phase.json"
+        bare.write_text(json.dumps({"value": 950.0}))
+        wrapped = tmp_path / "headline.json"
+        wrapped.write_text(json.dumps({"parsed": {"value": 100.0}}))
+        args = ["--metric", "cpu", "--tolerance", "0.2", "--from-json"]
+        assert bench.gate_main(args + [str(bare)]) == 0
+        assert bench.gate_main(args + [str(wrapped)]) == 1
+        capsys.readouterr()
+
+
+class TestFusedPhaseDispatch:
+    def test_kernel_fused_parses_block(self, bench, monkeypatch):
+        calls = []
+
+        def fake(block):
+            calls.append(block)
+            return {"phase": f"kernel-fused-w{block}"}
+
+        monkeypatch.setattr(bench, "_fused_kernel_bench", fake)
+        bench.run_phase("kernel-fused-w256")
+        bench.run_phase("kernel-fused-w512")
+        assert calls == [256, 512]
+
+    def test_decode_int8_dispatches(self, bench, monkeypatch):
+        def fake():
+            return {"phase": "decode-int8"}
+
+        monkeypatch.setattr(bench, "_decode_int8_bench", fake)
+        assert bench.run_phase("decode-int8")["phase"] == "decode-int8"
+
+    def test_new_phases_scheduled_with_timeouts(self, bench):
+        names = dict(bench._PHASES)
+        assert names["kernel-fused-w256"] > 0
+        assert names["kernel-fused-w512"] > 0
+        assert names["decode-int8"] > 0

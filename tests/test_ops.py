@@ -240,3 +240,78 @@ class TestSGU:
             return total
 
         assert macs(16) < 0.65 * macs(0)
+
+
+class TestFusedKernelReferences:
+    """The XLA golden compositions the fused Pallas layer kernels are
+    verified against (ops/pallas_layers.py): these must equal the
+    ACTUAL unfused model path — flax LayerNorm + shift_tokens +
+    causal_sgu_mix — or the kernel parity tests in
+    tests/test_pallas_layers.py prove the wrong thing. Pure XLA, so no
+    Pallas-API gate."""
+
+    def test_norm_reference_matches_flax_layernorm(self):
+        from flax import linen as nn
+
+        from progen_tpu.ops.pallas_layers import norm_reference
+
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 24))
+        scale = jnp.linspace(0.5, 1.5, 24).astype(jnp.float32)
+        ln = nn.LayerNorm(epsilon=1e-5, use_bias=False, use_scale=True)
+        ref = ln.apply({"params": {"scale": scale}}, x)
+        out = norm_reference(x, scale, 1e-5, "float32")
+        np.testing.assert_allclose(out, ref, atol=1e-6, rtol=1e-6)
+
+    def test_norm_shift_reference_is_shift_of_norm(self):
+        from progen_tpu.ops.pallas_layers import (
+            norm_reference,
+            norm_shift_reference,
+        )
+
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 24))
+        scale = jnp.ones((24,), jnp.float32)
+        out = norm_shift_reference(x, scale, 1e-5, "float32")
+        ref = shift_tokens(norm_reference(x, scale, 1e-5, "float32"))
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_sgu_reference_matches_unfused_composition(self):
+        from progen_tpu.ops.pallas_layers import (
+            norm_reference,
+            sgu_mix_gate_reference,
+        )
+
+        n, d = 32, 16
+        kx, kg, kw = jax.random.split(jax.random.PRNGKey(2), 3)
+        x = jax.random.normal(kx, (2, n, d))
+        gate = jax.random.normal(kg, (2, n, d))
+        w = jax.random.normal(kw, (n, n)) / n
+        bias = jnp.ones((n, 1), jnp.float32)
+        scale = jnp.linspace(0.8, 1.2, d).astype(jnp.float32)
+        out = sgu_mix_gate_reference(
+            x, gate, w, bias, scale, 1e-5, "float32"
+        )
+        g = norm_reference(gate, scale, 1e-5, "float32")
+        ref = x * causal_sgu_mix(g, w, bias)
+        np.testing.assert_allclose(out, ref, atol=1e-6, rtol=1e-6)
+
+    def test_sgu_reference_matches_blocked_mix(self):
+        # block_size>0 (the trained configs' setting) is the same math
+        # reassociated — the fused kernel must agree with BOTH forms
+        from progen_tpu.ops.pallas_layers import (
+            norm_reference,
+            sgu_mix_gate_reference,
+        )
+
+        n, d = 32, 16
+        kx, kg, kw = jax.random.split(jax.random.PRNGKey(3), 3)
+        x = jax.random.normal(kx, (1, n, d))
+        gate = jax.random.normal(kg, (1, n, d))
+        w = jax.random.normal(kw, (n, n)) / n
+        bias = jnp.ones((n, 1), jnp.float32)
+        scale = jnp.ones((d,), jnp.float32)
+        out = sgu_mix_gate_reference(
+            x, gate, w, bias, scale, 1e-5, "float32"
+        )
+        g = norm_reference(gate, scale, 1e-5, "float32")
+        ref = x * causal_sgu_mix(g, w, bias, 16)
+        np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)

@@ -217,3 +217,132 @@ class TestCompileOnce:
         sched.run_to_completion(max_steps=2000)
         assert ServeEngine.decode_compile_count() == decode_after_first
         assert ServeEngine.prefill_compile_count() == prefill_after_first
+
+
+class TestInt8Decode:
+    """Int8 weight-only quantization (ops/quant.py + quantize_int8=True):
+    scheme selectivity, calibration honesty, and the distributional
+    closeness of the quantized decode path to full precision. The int8
+    stream is NOT bit-identical to fp (that's the accuracy trade the
+    calibration report quantifies), so these tests assert bounded
+    divergence, not token equality."""
+
+    def test_quantize_tree_targets_matmul_kernels_only(
+        self, model_and_params
+    ):
+        from progen_tpu.ops.quant import quantize_tree
+
+        _, params = model_and_params
+        q_params, scales, report = quantize_tree(params)
+        assert jax.tree_util.tree_structure(
+            q_params
+        ) == jax.tree_util.tree_structure(params)
+        assert len(report) == len(scales) > 0
+        for entry in report:
+            assert entry["path"].endswith("'kernel']")
+            assert len(entry["shape"]) == 2
+            assert entry["bytes_int8"] < entry["bytes_fp"]
+        quantized = {e["path"] for e in report}
+
+        def check(path, fp_leaf):
+            key = jax.tree_util.keystr(path)
+            q_leaf = q_params
+            for p in path:
+                q_leaf = q_leaf[p.key]
+            if key in quantized:
+                assert q_leaf.dtype == jnp.int8
+            else:  # embeddings, norms, biases, spatial mix: untouched
+                assert q_leaf.dtype == fp_leaf.dtype
+
+        jax.tree_util.tree_map_with_path(check, params)
+
+    def test_dequantize_error_within_one_step(self, model_and_params):
+        from progen_tpu.ops.quant import dequantize_tree, quantize_tree
+
+        _, params = model_and_params
+        q_params, scales, report = quantize_tree(params)
+        deq = dequantize_tree(q_params, scales, jnp.float32)
+        by_path = {e["path"]: e for e in report}
+
+        def check(path, fp_leaf):
+            key = jax.tree_util.keystr(path)
+            if key not in by_path:
+                return
+            d_leaf = deq
+            for p in path:
+                d_leaf = d_leaf[p.key]
+            err = float(
+                jnp.max(jnp.abs(d_leaf - fp_leaf.astype(jnp.float32)))
+            )
+            # symmetric rounding: at most half an int8 step per channel
+            amax = float(jnp.max(jnp.abs(fp_leaf)))
+            assert err <= amax / 127.0 * 0.5 + 1e-6
+            assert err == pytest.approx(
+                by_path[key]["max_abs_err"], abs=1e-6
+            )
+
+        jax.tree_util.tree_map_with_path(check, params)
+
+    def test_engine_calibration_report(self, model_and_params):
+        model, params = model_and_params
+        engine = ServeEngine(
+            model, params, max_slots=2, max_len=32, quantize_int8=True
+        )
+        rep = engine.quant_report
+        assert rep is not None and rep["bits"] == 8
+        assert rep["quantized_leaves"] == len(rep["leaves"]) > 0
+        assert rep["bytes_int8"] < rep["bytes_fp"] / 2
+        assert rep["weight_max_abs_err"] < 0.05
+        assert rep["logits_max_abs_err"] < 1.0
+        fp_engine = ServeEngine(model, params, max_slots=2, max_len=32)
+        assert fp_engine.quant_report is None
+
+    def test_teacher_forced_distribution_close(self, model_and_params):
+        """Softmax total-variation distance between fp and dequantized
+        params on a fixed prompt — the distributional check behind the
+        per-token agreement the decode-int8 bench reports."""
+        from progen_tpu.ops.quant import dequantize_tree, quantize_tree
+
+        model, params = model_and_params
+        q_params, scales, _ = quantize_tree(params)
+        deq = dequantize_tree(q_params, scales, jnp.float32)
+        prompt = [1, 7, 23, 4, 9, 2, 15, 30]
+        tokens = jnp.array(
+            [prompt * (TINY.seq_len // len(prompt))], jnp.int32
+        )
+        p = jax.nn.softmax(
+            model.apply({"params": params}, tokens).astype(jnp.float32)
+        )
+        q = jax.nn.softmax(
+            model.apply({"params": deq}, tokens).astype(jnp.float32)
+        )
+        tv = float(jnp.max(0.5 * jnp.sum(jnp.abs(p - q), axis=-1)))
+        assert tv < 0.1
+
+    def test_int8_decode_mostly_agrees_with_fp(self, model_and_params):
+        model, params = model_and_params
+        streams = {}
+        for int8 in (False, True):
+            engine = ServeEngine(
+                model, params, max_slots=2, max_len=32,
+                quantize_int8=int8,
+            )
+            sched = Scheduler(engine, max_queue=8)
+            for i in range(2):
+                ok, reason = sched.submit(Request(
+                    id=f"r{i}", prime=np.array([1, 5 + i]), length=24,
+                    key=jax.random.PRNGKey(42 + i),
+                ))
+                assert ok, reason
+            _, done = sched.run_to_completion(max_steps=500)
+            streams[int8] = {
+                c.request_id: np.asarray(c.tokens) for c in done
+            }
+        agree = total = 0
+        for rid, fp_toks in streams[False].items():
+            q_toks = streams[True][rid]
+            n = min(len(fp_toks), len(q_toks))
+            agree += int((fp_toks[:n] == q_toks[:n]).sum())
+            total += n
+        assert total > 0
+        assert agree / total >= 0.6

@@ -250,3 +250,100 @@ def test_summarize_cli_report(run_dir):
     assert "span latency" in out
     assert "train/step" in out
     assert "retry" in out  # event counts section
+
+
+class TestRetryFlowEvents:
+    """Retry instants additionally open flow arrows (ph "s" -> "f",
+    bp "e") to the END of the innermost span open when they fired —
+    the viewer line from the fault to the operation that absorbed its
+    latency."""
+
+    def test_retry_binds_to_enclosing_span(self):
+        trace = build_trace(_sample_events())
+        flows = [
+            e for e in trace["traceEvents"] if e.get("cat") == "flow"
+        ]
+        assert [e["ph"] for e in flows] == ["s", "f"]
+        start, end = flows
+        assert start["name"] == end["name"] == "retry_absorbed"
+        assert start["id"] == end["id"]
+        # start pinned at the retry instant, on the absorbing span's
+        # pid/tid track (ckpt/save: pid 1, tid 22)
+        assert start["ts"] == pytest.approx(12.6 * 1e6)
+        assert (start["pid"], start["tid"]) == (1, 22)
+        # end lands at the span's E, binding-point "e" (enclosing slice)
+        assert end["ts"] == pytest.approx(13.0 * 1e6)
+        assert end["bp"] == "e"
+        assert (end["pid"], end["tid"]) == (1, 22)
+        # the plain instant event still renders alongside the flow
+        assert any(
+            e["ph"] == "i" and e["name"] == "retry"
+            for e in trace["traceEvents"]
+        )
+
+    def test_retry_outside_any_span_stays_bare_instant(self):
+        trace = build_trace([
+            {"ev": "retry", "label": "io", "ts": 5.0, "pid": 0},
+        ])
+        evs = trace["traceEvents"]
+        assert any(e["ph"] == "i" and e["name"] == "retry" for e in evs)
+        assert not any(e.get("cat") == "flow" for e in evs)
+
+    def test_retry_in_never_closed_span_emits_start_only(self):
+        # crash mid-span: the flow start still marks the absorbing span
+        trace = build_trace([
+            {"ev": "B", "span": "ckpt/save", "id": 1, "ts": 1.0,
+             "pid": 0, "tid": 7, "thread": "ckpt"},
+            {"ev": "retry", "label": "io", "ts": 1.5, "pid": 0},
+        ])
+        flows = [
+            e for e in trace["traceEvents"] if e.get("cat") == "flow"
+        ]
+        assert [e["ph"] for e in flows] == ["s"]
+        assert flows[0]["tid"] == 7
+
+    def test_nested_spans_bind_innermost_and_ids_unique(self):
+        events = [
+            {"ev": "B", "span": "train/step", "id": 0, "ts": 1.0,
+             "pid": 0, "tid": 1, "thread": "main"},
+            {"ev": "B", "span": "ckpt/save", "id": 1, "ts": 2.0,
+             "pid": 0, "tid": 1, "thread": "main"},
+            {"ev": "retry", "label": "io", "ts": 2.5, "pid": 0},
+            {"ev": "E", "span": "ckpt/save", "id": 1, "ts": 3.0,
+             "pid": 0, "tid": 1, "thread": "main"},
+            {"ev": "retry", "label": "io", "ts": 3.5, "pid": 0},
+            {"ev": "E", "span": "train/step", "id": 0, "ts": 4.0,
+             "pid": 0, "tid": 1, "thread": "main"},
+        ]
+        flows = [
+            e for e in build_trace(events)["traceEvents"]
+            if e.get("cat") == "flow"
+        ]
+        by_id = {}
+        for e in flows:
+            by_id.setdefault(e["id"], []).append(e)
+        assert len(by_id) == 2
+        for fid, pair in by_id.items():
+            assert [e["ph"] for e in pair] == ["s", "f"]
+        # first retry ends at ckpt/save's E (3.0), second at
+        # train/step's E (4.0) — each bound to its innermost span
+        ends = sorted(
+            e["ts"] for e in flows if e["ph"] == "f"
+        )
+        assert ends == [
+            pytest.approx(3.0 * 1e6), pytest.approx(4.0 * 1e6)
+        ]
+
+    def test_flows_ignore_other_pids_spans(self):
+        events = [
+            {"ev": "B", "span": "train/step", "id": 0, "ts": 1.0,
+             "pid": 0, "tid": 1, "thread": "main"},
+            {"ev": "retry", "label": "io", "ts": 1.5, "pid": 1},
+            {"ev": "E", "span": "train/step", "id": 0, "ts": 2.0,
+             "pid": 0, "tid": 1, "thread": "main"},
+        ]
+        flows = [
+            e for e in build_trace(events)["traceEvents"]
+            if e.get("cat") == "flow"
+        ]
+        assert flows == []  # host 1's retry can't bill host 0's span
