@@ -18,7 +18,15 @@ dashboard query then matches nothing. Three checks:
   * string-literal metric names fed to the registry (``.inc``,
     ``.set_gauge``, ``.observe``, ``.set_gauges`` keys) and literal
     ``"ev"`` values must already satisfy the Prometheus name rules the
-    renderer enforces (``[a-zA-Z_:][a-zA-Z0-9_:]*``).
+    renderer enforces (``[a-zA-Z_:][a-zA-Z0-9_:]*``) — this covers the
+    PR-7 names (``clock_beacon``, ``itl_s``, ``slots`` /
+    ``slot_occupancy``) like any other;
+  * raw ``"ev": "req"`` async-lifecycle records must not be emitted
+    outside ``serving/scheduler.py`` — the scheduler owns the
+    queued/prefill/decode phase grammar and the every-``b``-gets-its-
+    ``e`` exception-safety burden (same reasoning as B/E ↔ spans.py),
+    and a literal ``"ph"`` in a req record must be one of
+    ``"b"``/``"n"``/``"e"`` (the async trace-event alphabet).
 """
 
 from __future__ import annotations
@@ -46,6 +54,11 @@ class TelemetryHygieneRule(Rule):
     def _in_spans_module(self) -> bool:
         return self.ctx.path.replace("\\", "/").endswith(
             "telemetry/spans.py"
+        )
+
+    def _in_scheduler_module(self) -> bool:
+        return self.ctx.path.replace("\\", "/").endswith(
+            "serving/scheduler.py"
         )
 
     def _enclosing_params(self, node) -> set:
@@ -110,12 +123,36 @@ class TelemetryHygieneRule(Rule):
                     "span() context manager, whose finally-block "
                     "guarantees the matching E even on exceptions",
                 )
+            elif v.value == "req":
+                if not self._in_scheduler_module():
+                    self.report(
+                        v,
+                        "raw async req record emitted outside "
+                        "serving/scheduler.py — the scheduler owns the "
+                        "request lifecycle grammar (every 'b' must get "
+                        "its 'e' on all exit paths); go through "
+                        "Scheduler, not hand-rolled records",
+                    )
+                self._check_req_ph(d)
             elif not _PROM_NAME_RE.match(v.value):
                 self.report(
                     v,
                     f"event tag '{v.value}' is not a clean identifier "
                     f"([a-zA-Z_][a-zA-Z0-9_]*) — downstream tooling "
                     f"keys on it",
+                )
+
+    def _check_req_ph(self, d: ast.Dict) -> None:
+        for k, v in zip(d.keys, d.values):
+            if not (_str_const(k) and k.value == "ph"):
+                continue
+            if _str_const(v) and v.value not in ("b", "n", "e"):
+                self.report(
+                    v,
+                    f"req record 'ph' is '{v.value}' — async trace "
+                    f"events only use 'b' (begin), 'n' (instant), "
+                    f"'e' (end); anything else is dropped by the "
+                    f"trace builder",
                 )
 
     def _check_prom_name(self, node, name: str) -> None:

@@ -178,6 +178,7 @@ def _build(checkpoint_path, max_slots, max_len, max_queue,
 def main(checkpoint_path, max_slots, max_queue, max_len, quantize_int8,
          top_k, temperature, top_p, seed, socket_path, metrics_every,
          prom_file, prom_port):
+    from progen_tpu import telemetry
     from progen_tpu.telemetry import (
         prometheus_text,
         start_prometheus_server,
@@ -192,6 +193,18 @@ def main(checkpoint_path, max_slots, max_queue, max_len, quantize_int8,
         "temperature": temperature, "top_p": top_p, "seed": seed,
     }
     tracker = make_tracker("progen-serve")
+    # per-request async tracing: the scheduler's req/slots records and
+    # the engine's serve/prefill spans land in the tracker's
+    # events.jsonl — `progen-tpu-telemetry export-trace` renders each
+    # accepted request as one async track (queued → prefill → decode)
+    telemetry.configure(sink=tracker.log_event)
+    run_dir = getattr(tracker, "path", None)
+    if run_dir is not None:
+        print(
+            f"request traces: {run_dir}/events.jsonl "
+            "(render with progen-tpu-telemetry export-trace)",
+            file=sys.stderr,
+        )
 
     def publish(step=None):
         sched.metrics.log_to(tracker, step=step)
@@ -249,6 +262,7 @@ def main(checkpoint_path, max_slots, max_queue, max_len, quantize_int8,
         publish()
         if prom_srv is not None:
             prom_srv.shutdown()
+        telemetry.configure()  # detach before the sink closes
         tracker.finish()
 
 

@@ -12,8 +12,15 @@ post-mortem actually wants:
     and HBM.
   * ``summarize`` — terminal report: per-host goodput table with the
     cross-host skew/straggler breakdown, per-span-name p50/p95/p99
-    latency (reservoir quantiles over every completed span), and
-    resilience event counts.
+    latency (reservoir quantiles over every completed span), serving
+    request-phase + TTFT/ITL latency quantiles when the stream came
+    from a serve run, and resilience event counts.
+  * ``stitch`` — N hosts' events.jsonl → ONE fleet trace on a common
+    corrected clock (clock_beacon-anchored skew correction, cross-host
+    step flow arrows, fleet-wide goodput skew).
+
+All three report how many torn/garbage input lines they had to skip —
+a trace that silently lost records is an observability bug.
 
 Run: python -m progen_tpu.cli.telemetry export-trace logs/events.jsonl
 """
@@ -26,11 +33,21 @@ import click
 
 from progen_tpu.telemetry.goodput import goodput_skew
 from progen_tpu.telemetry.registry import _Timing
+from progen_tpu.telemetry.stitch import stitch_trace
 from progen_tpu.telemetry.trace import (
     INSTANT_EVENTS,
+    LineDrops,
     export_trace,
     iter_jsonl,
 )
+
+
+def _echo_drops(n: int) -> None:
+    if n:
+        click.echo(
+            f"WARNING: skipped {n} torn/garbage line"
+            f"{'s' if n != 1 else ''} in the input stream(s)"
+        )
 
 
 @click.group()
@@ -66,16 +83,75 @@ def export_trace_cmd(events, metrics, out):
     trace = export_trace(events, out, metrics_path=metrics)
     n = len(trace["traceEvents"])
     click.echo(f"wrote {out} ({n} trace events)")
+    _echo_drops(trace.get("progenDroppedLines", 0))
     click.echo("open at https://ui.perfetto.dev or chrome://tracing")
 
 
-def _host_reports(events_path, metrics_path) -> list:
+@main.command("stitch")
+@click.argument(
+    "events", nargs=-1, required=True,
+    type=click.Path(exists=True, dir_okay=False),
+)
+@click.option(
+    "--metrics", "metrics_paths", multiple=True,
+    type=click.Path(exists=True, dir_okay=False),
+    help="per-host metrics.jsonl, repeatable; zipped positionally "
+         "with the EVENTS arguments",
+)
+@click.option(
+    "--out", type=click.Path(dir_okay=False), default=None,
+    help="output trace path (default: stitched_trace.json beside the "
+         "first EVENTS file)",
+)
+@click.option(
+    "--reference", default=0, show_default=True,
+    help="host whose clock the fleet is corrected onto",
+)
+def stitch_cmd(events, metrics_paths, out, reference):
+    """Merge N hosts' EVENTS files into ONE clock-aligned fleet trace.
+
+    Per-host clock skew is corrected from the clock_beacon records the
+    train loop emits at step boundaries (median beacon delta vs the
+    reference host); cross-host step_sync flow arrows link each step's
+    beacons so a straggler renders as an arrow fan."""
+    if out is None:
+        out = str(Path(events[0]).with_name("stitched_trace.json"))
+    trace = stitch_trace(
+        list(events), out_path=out,
+        metrics_paths=list(metrics_paths), reference=reference,
+    )
+    info = trace.get("progenStitch", {})
+    offsets = trace.get("progenClockOffsets", {})
+    click.echo(
+        f"wrote {out} ({len(trace['traceEvents'])} trace events from "
+        f"{info.get('hosts', len(events))} host streams)"
+    )
+    if offsets:
+        for h in sorted(offsets, key=int):
+            click.echo(
+                f"  host {h}: clock offset "
+                f"{float(offsets[h]) * 1e3:+.3f} ms vs host {reference}"
+            )
+        click.echo(
+            f"  {info.get('beacon_steps', 0)} beacon steps, "
+            f"{info.get('flow_arrows', 0)} cross-host step arrows"
+        )
+    else:
+        click.echo(
+            "  no clock_beacon records found — streams merged on raw "
+            "(uncorrected) host clocks"
+        )
+    _echo_drops(trace.get("progenDroppedLines", 0))
+    click.echo("open at https://ui.perfetto.dev or chrome://tracing")
+
+
+def _host_reports(events_path, metrics_path, drops=None) -> list:
     """Latest per-host goodput reports. Primary source: the
     ``goodput_host`` records every host emits at end of run. Fallback
     for runs predating per-host emission: the last metrics.jsonl row
     carrying ``goodput_pct`` becomes host 0's report."""
     by_host: dict = {}
-    for rec in iter_jsonl(events_path):
+    for rec in iter_jsonl(events_path, drops):
         if rec.get("ev") == "goodput_host" and "host" in rec:
             by_host[int(rec["host"])] = {
                 k: v for k, v in rec.items()
@@ -85,7 +161,7 @@ def _host_reports(events_path, metrics_path) -> list:
         return [by_host[h] for h in sorted(by_host)]
     if metrics_path is not None and Path(metrics_path).exists():
         last = None
-        for rec in iter_jsonl(metrics_path):
+        for rec in iter_jsonl(metrics_path, drops):
             if "goodput_pct" in rec:
                 last = rec
         if last is not None:
@@ -122,6 +198,9 @@ def summarize_cmd(events, metrics, top_spans):
         sibling = events.with_name("metrics.jsonl")
         metrics = str(sibling) if sibling.exists() else None
 
+    # each input file is drop-counted exactly once (the goodput-report
+    # pass below re-reads the same files, so it is left uncounted)
+    drops = LineDrops()
     reports = _host_reports(events, metrics)
     if reports:
         click.echo("== goodput (per host) ==")
@@ -159,12 +238,25 @@ def summarize_cmd(events, metrics, top_spans):
 
     timings: dict = {}
     counts: dict = {}
-    for rec in iter_jsonl(events):
+    open_req: dict = {}
+    for rec in iter_jsonl(events, drops):
         ev = rec.get("ev")
         if ev == "E" and "dur_s" in rec:
             timings.setdefault(
                 str(rec.get("span", "?")), _Timing()
             ).observe(float(rec["dur_s"]))
+        elif ev == "req":
+            # request lifecycle phases: pair b/e per (request, phase)
+            # into req/<phase> timing families in the span table
+            ph, rid, name = rec.get("ph"), rec.get("req"), rec.get("name")
+            if ph == "b":
+                open_req[(rid, name)] = rec.get("ts")
+            elif ph == "e":
+                t0 = open_req.pop((rid, name), None)
+                if t0 is not None and rec.get("ts") is not None:
+                    timings.setdefault(
+                        f"req/{name}", _Timing()
+                    ).observe(float(rec["ts"]) - float(t0))
         elif ev not in ("B", "E", None):
             counts[str(ev)] = counts.get(str(ev), 0) + 1
 
@@ -187,12 +279,35 @@ def summarize_cmd(events, metrics, top_spans):
             click.echo(f"... {len(families) - top_spans} more (--spans)")
         click.echo("")
 
+    serve_row = None
+    if metrics is not None and Path(metrics).exists():
+        for rec in iter_jsonl(metrics, drops):
+            if any(k.startswith("serve/") for k in rec):
+                serve_row = rec  # last snapshot wins (cumulative)
+    if serve_row is not None:
+        click.echo("== serving latency (s) ==")
+        click.echo(
+            f"{'metric':<12} {'count':>6} {'p50':>9} {'p95':>9} {'p99':>9}"
+        )
+        for fam in ("ttft_s", "itl_s", "latency_s"):
+            if f"serve/{fam}_count" not in serve_row:
+                continue
+            click.echo(
+                f"{fam:<12} "
+                f"{int(serve_row[f'serve/{fam}_count']):>6} "
+                f"{serve_row.get(f'serve/{fam}_p50_s', 0.0):>9.4f} "
+                f"{serve_row.get(f'serve/{fam}_p95_s', 0.0):>9.4f} "
+                f"{serve_row.get(f'serve/{fam}_p99_s', 0.0):>9.4f}"
+            )
+        click.echo("")
+
     if counts:
         click.echo("== events ==")
         order = [e for e in INSTANT_EVENTS if e in counts]
         order += sorted(set(counts) - set(order))
         for ev in order:
             click.echo(f"{ev:<24} {counts[ev]:>6}")
+    _echo_drops(drops.count)
 
 
 if __name__ == "__main__":

@@ -210,6 +210,7 @@ def main(
 
     sample_tokens = sample if naive_sample else sample_fast
     from progen_tpu.tracking import make_tracker, render_sample_html
+    from progen_tpu.training import emit_clock_beacon
     from progen_tpu.training.optimizer import make_optimizer
     from progen_tpu.training.step import (
         abstract_train_state,
@@ -611,6 +612,10 @@ def main(
                 # host sync fence: the wait here IS the device step time
                 # (or, for the first step under lazy jit, the compile)
                 loss = float(p_metrics["last_micro_loss"])
+            # the fetch above is the post-collective barrier every host
+            # just crossed together: beacon it so `telemetry stitch`
+            # can align the fleet's clocks on this step boundary
+            emit_clock_beacon(p_step)
             grad_norm = float(p_metrics["grad_norm"])
             skipped = int(p_metrics.get("skipped", 0))
             # chaos perturbation point: PROGEN_CHAOS="train/loss:spike@2"

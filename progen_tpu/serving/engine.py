@@ -48,6 +48,7 @@ from progen_tpu.sampling import (
     _validate_knobs,
     gumbel_step_dynamic,
 )
+from progen_tpu.telemetry.spans import span as _span
 
 logger = logging.getLogger(__name__)
 
@@ -379,38 +380,43 @@ class ServeEngine:
 
     def prefill(self, slot: int, prime, length: int, *,
                 top_k=25, add_bos: bool = False, temperature: float = 1.0,
-                top_p=None, key=None, seed: int = 0) -> int:
+                top_p=None, key=None, seed: int = 0,
+                request_id: Optional[str] = None) -> int:
         """Admit a request into ``slot``. Returns the number of primed
         positions (``start``). The slot's stream is bit-identical to
-        ``sample_fast(key, model, params, prime, length, ...)``."""
+        ``sample_fast(key, model, params, prime, length, ...)``.
+        ``request_id`` is telemetry-only: the prefill span carries it so
+        the trace ties device work back to the request's async track."""
         self.validate(prime, length, add_bos=add_bos,
                       temperature=temperature, top_p=top_p, top_k=top_k)
-        seq, start = _prepare_seq(self.model, prime, length, add_bos)
-        row = np.zeros((self.max_len,), np.int32)
-        row[: int(seq.shape[0])] = np.asarray(seq)
-        if key is None:
-            key = jax.random.PRNGKey(seed)
-        parity = temperature == 1.0 and top_p is None
-        tail = (
-            jnp.int32(slot), jnp.asarray(row), jnp.int32(start),
-            jnp.int32(length), key,
-            jnp.float32(temperature),
-            jnp.float32(_TOP_P_OFF if top_p is None else top_p),
-            jnp.int32(0 if top_k is None else top_k),
-            jnp.asarray(parity),
-        )
-        if self.quantize_int8:
-            self.slots = _prefill_q(
-                self.model, self._q_params, self._q_scales, self.slots,
-                self.fresh_cache, *tail,
+        with _span("serve/prefill", slot=int(slot),
+                   request_id="" if request_id is None else str(request_id)):
+            seq, start = _prepare_seq(self.model, prime, length, add_bos)
+            row = np.zeros((self.max_len,), np.int32)
+            row[: int(seq.shape[0])] = np.asarray(seq)
+            if key is None:
+                key = jax.random.PRNGKey(seed)
+            parity = temperature == 1.0 and top_p is None
+            tail = (
+                jnp.int32(slot), jnp.asarray(row), jnp.int32(start),
+                jnp.int32(length), key,
+                jnp.float32(temperature),
+                jnp.float32(_TOP_P_OFF if top_p is None else top_p),
+                jnp.int32(0 if top_k is None else top_k),
+                jnp.asarray(parity),
             )
-        else:
-            self.slots = _prefill(
-                self.model, self.params, self.slots, self.fresh_cache,
-                *tail,
-            )
-        self._targets[slot] = int(length)
-        return int(start)
+            if self.quantize_int8:
+                self.slots = _prefill_q(
+                    self.model, self._q_params, self._q_scales, self.slots,
+                    self.fresh_cache, *tail,
+                )
+            else:
+                self.slots = _prefill(
+                    self.model, self.params, self.slots, self.fresh_cache,
+                    *tail,
+                )
+            self._targets[slot] = int(length)
+            return int(start)
 
     # ----- the hot loop ---------------------------------------------------
 

@@ -11,6 +11,13 @@ token counts and wall-clock time around the prefill/decode calls, and
 ``snapshot()`` divides. That makes decode_tokens_per_s a true
 steady-state number (tokens that actually advanced / time the device
 actually spent), not a gauge that depends on when you look.
+
+Latency lands in three reservoir-quantile families the scheduler
+observes: ``ttft_s`` (submit → first token), ``itl_s`` (inter-token
+latency — the gap between consecutive tokens of ONE request; the
+number a streaming client actually feels between characters), and
+``latency_s`` (submit → done). All three render as Prometheus
+summaries with p50/p95/p99.
 """
 
 from __future__ import annotations
@@ -42,6 +49,13 @@ class ServingMetrics:
 
     def observe(self, name: str, seconds: float) -> None:
         self._timings.setdefault(name, _Timing()).observe(seconds)
+
+    def declare_timing(self, name: str) -> None:
+        """Pre-register a timing family at zero observations so the
+        Prometheus exposition carries it from process start (a scraper
+        needs ``itl_seconds_count 0`` — an absent family looks like a
+        broken exporter, not an idle server)."""
+        self._timings.setdefault(name, _Timing())
 
     def add_time(self, name: str, seconds: float) -> None:
         self._times[name] = self._times.get(name, 0.0) + seconds

@@ -11,6 +11,18 @@ Backpressure is explicit: the queue is bounded and ``submit`` answers
 *why* it should shed load ("queue_full") versus bounce a bad request
 ("invalid: ..."). Invalid requests are rejected at submit time (engine
 validation, no device work) so they never occupy queue space.
+
+Every accepted request is additionally traced through the process
+telemetry as ONE async track (``{"ev": "req", "ph": "b"/"n"/"e"}``
+records, id = request): a ``request`` envelope containing the
+``queued`` → ``prefill`` → ``decode`` lifecycle phases, with instants
+for first_token / deadline_exceeded / drain and a slot-occupancy
+counter stream. The ``ph`` grammar and the exception-safety burden are
+owned HERE (and linted to stay here — PGL006): phases are closed on
+every exit path, including sheds, so a ``b`` without its ``e`` in
+events.jsonl means the process died mid-phase, same contract as spans.
+Trace timestamps are ``time.time()`` wall clock (the events.jsonl
+timebase), independent of the injectable ``clock`` used for deadlines.
 """
 
 from __future__ import annotations
@@ -24,6 +36,7 @@ import numpy as np
 
 from progen_tpu.serving.engine import ServeEngine
 from progen_tpu.serving.metrics import ServingMetrics
+from progen_tpu.telemetry.spans import get_telemetry
 
 REJECT_QUEUE_FULL = "queue_full"
 REJECT_DEADLINE = "deadline_exceeded"
@@ -82,6 +95,7 @@ class _Active:
     t_submit: float
     t_admit: float
     first_token_t: Optional[float] = None
+    last_token_t: Optional[float] = None
     n_generated: int = 0
 
 
@@ -104,6 +118,54 @@ class Scheduler:
         # queued requests expired/shed since the last ``pop_expired()``:
         # (request, reason) — the front-end owns client notification
         self._expired: List[Tuple[Request, str]] = []
+        self._last_slots_emitted: Optional[int] = None
+        # latency families exist (at zero) from construction so the
+        # Prometheus exposition is stable before the first request
+        for fam in ("ttft_s", "itl_s", "latency_s"):
+            self.metrics.declare_timing(fam)
+
+    # ----- request tracing ------------------------------------------------
+
+    def _req_event(self, ph: str, rid: str, name: str,
+                   ts: Optional[float] = None, **attrs) -> None:
+        """One async-lifecycle record on the process telemetry. No-op
+        cost when no sink is configured (the default in tests/bench)."""
+        rec = {
+            "ev": "req", "ph": ph, "name": name, "req": rid,
+            "ts": time.time() if ts is None else ts,
+        }
+        if attrs:
+            rec.update(attrs)
+        get_telemetry().emit(rec)
+
+    def _emit_slots(self) -> None:
+        """Slot-occupancy counter sample, on change only."""
+        n = len(self._active)
+        if n == self._last_slots_emitted:
+            return
+        self._last_slots_emitted = n
+        get_telemetry().emit({
+            "ev": "slots", "ts": time.time(), "in_use": n,
+            "free": self.engine.max_slots - n,
+        })
+
+    def _reject_traced(self, rid: str, reason: str) -> None:
+        """Submit-time rejects never open an async track (nothing was
+        accepted); a plain instant on the host track records them."""
+        get_telemetry().emit({
+            "ev": "request_rejected", "ts": time.time(), "req": rid,
+            "reason": reason,
+        })
+
+    def _shed_traced(self, rid: str, reason: str,
+                     ts: Optional[float] = None) -> None:
+        """Close an accepted-but-never-admitted request's track: the
+        shed instant, then the still-open queued phase, then the
+        envelope."""
+        ts = time.time() if ts is None else ts
+        self._req_event("n", rid, reason, ts=ts)
+        self._req_event("e", rid, "queued", ts=ts)
+        self._req_event("e", rid, "request", ts=ts, reason=reason)
 
     # ----- intake ---------------------------------------------------------
 
@@ -121,17 +183,24 @@ class Scheduler:
         except ValueError as e:
             self.metrics.inc("requests_rejected")
             self.metrics.inc("rejected_invalid")
+            self._reject_traced(req.id, "invalid")
             return False, f"invalid: {e}"
         if req.deadline_s is not None and req.deadline_s <= 0:
             self.metrics.inc("requests_rejected")
             self.metrics.inc("rejected_invalid")
+            self._reject_traced(req.id, "invalid")
             return False, f"invalid: deadline_s must be > 0, got {req.deadline_s}"
         if len(self._queue) >= self.max_queue:
             self.metrics.inc("requests_rejected")
             self.metrics.inc("rejected_queue_full")
+            self._reject_traced(req.id, REJECT_QUEUE_FULL)
             return False, REJECT_QUEUE_FULL
         self._queue.append((req, self._clock()))
         self.metrics.set_gauge("queue_depth", len(self._queue))
+        now = time.time()
+        self._req_event("b", req.id, "request", ts=now,
+                        length=int(req.length))
+        self._req_event("b", req.id, "queued", ts=now)
         return True, None
 
     # ----- the loop -------------------------------------------------------
@@ -165,6 +234,7 @@ class Scheduler:
                 self.metrics.inc("requests_rejected")
                 self.metrics.inc("rejected_deadline_exceeded")
                 self._expired.append((req, REJECT_DEADLINE))
+                self._shed_traced(req.id, REJECT_DEADLINE)
             else:
                 kept.append((req, t_submit))
         self._queue = kept
@@ -187,6 +257,7 @@ class Scheduler:
             self.metrics.inc("requests_rejected")
             self.metrics.inc(f"rejected_{reason}")
             self._expired.append((req, reason))
+            self._shed_traced(req.id, reason)
         self.metrics.set_gauge("queue_depth", 0)
         return n
 
@@ -196,13 +267,20 @@ class Scheduler:
             if slot is None:
                 break
             req, t_submit = self._queue.popleft()
+            w0 = time.time()
+            self._req_event("e", req.id, "queued", ts=w0)
+            self._req_event("b", req.id, "prefill", ts=w0, slot=slot)
             t0 = self._clock()
             start = self.engine.prefill(
                 slot, req.prime, req.length, top_k=req.top_k,
                 add_bos=req.add_bos, temperature=req.temperature,
                 top_p=req.top_p, key=req.key, seed=req.seed,
+                request_id=req.id,
             )
             t1 = self._clock()
+            w1 = time.time()
+            self._req_event("e", req.id, "prefill", ts=w1)
+            self._req_event("b", req.id, "decode", ts=w1, slot=slot)
             self._active[slot] = _Active(req, slot, start, t_submit, t1)
             self.metrics.inc("requests_admitted")
             # start-1 prime tokens actually ran through the model
@@ -210,6 +288,7 @@ class Scheduler:
             self.metrics.add_time("prefill_time_s", t1 - t0)
         self.metrics.set_gauge("queue_depth", len(self._queue))
         self.metrics.set_gauge("active_slots", len(self._active))
+        self._emit_slots()
 
     def step(self) -> Tuple[List[TokenEvent], List[Completion]]:
         """Admit what fits, then advance every live slot one token.
@@ -237,6 +316,13 @@ class Scheduler:
             if rec.first_token_t is None:
                 rec.first_token_t = now
                 self.metrics.observe("ttft_s", now - rec.t_submit)
+                self._req_event("n", rec.req.id, "first_token")
+            else:
+                # inter-token latency: gap between consecutive tokens
+                # of THIS request (== decode-step period while the slot
+                # stays live, but attributed per request)
+                self.metrics.observe("itl_s", now - rec.last_token_t)
+            rec.last_token_t = now
             done = bool(finished[slot])
             events.append(
                 TokenEvent(
@@ -260,6 +346,11 @@ class Scheduler:
         del self._active[slot]
         self.metrics.inc("requests_completed")
         self.metrics.observe("latency_s", now - rec.t_submit)
+        done_t = time.time()
+        self._req_event("e", rec.req.id, "decode", ts=done_t)
+        self._req_event("e", rec.req.id, "request", ts=done_t,
+                        n_generated=rec.n_generated)
+        self._emit_slots()
         return Completion(
             request_id=rec.req.id,
             tokens=tokens,

@@ -25,7 +25,10 @@ hung or slow run into a one-line diagnosis (MegaScale NSDI'24, Dapper
   * ``trace.build_trace`` / ``export_trace`` — events.jsonl → Chrome
     Trace Event / Perfetto JSON (the ``telemetry export-trace`` CLI);
   * ``per_host_reports`` / ``goodput_skew`` / ``emit_per_host_goodput``
-    — MegaScale-style per-host goodput + straggler skew table.
+    — MegaScale-style per-host goodput + straggler skew table;
+  * ``stitch_trace`` / ``clock_offsets`` / ``emit_clock_beacon`` —
+    N hosts' event files → ONE fleet trace on a common corrected clock
+    (the ``telemetry stitch`` CLI), beacon-anchored skew correction.
 
 Everything is CPU-testable; nothing here imports jax at module scope.
 """
@@ -53,6 +56,12 @@ from progen_tpu.telemetry.spans import (
     span,
     step_print,
 )
+from progen_tpu.telemetry.stitch import (
+    clock_offsets,
+    emit_clock_beacon,
+    stitch_streams,
+    stitch_trace,
+)
 from progen_tpu.telemetry.trace import build_trace, export_trace
 from progen_tpu.telemetry.watchdog import StallWatchdog
 
@@ -78,4 +87,8 @@ __all__ = [
     "get_registry",
     "build_trace",
     "export_trace",
+    "clock_offsets",
+    "emit_clock_beacon",
+    "stitch_streams",
+    "stitch_trace",
 ]

@@ -11,8 +11,16 @@ direct by design:
     renders as two process tracks and the watchdog/checkpoint threads
     get their own rows;
   * one-shot records (``retry``, ``anomaly``, ``stall``, ``chaos``,
-    ``ckpt_commit_failed``, …) → instant events (``ph: "i"``) pinned to
-    their host track;
+    ``ckpt_commit_failed``, ``clock_beacon``, …) → instant events
+    (``ph: "i"``) pinned to their host track;
+  * serving ``req`` records (scheduler lifecycle: queued → prefill →
+    decode, emitted with ``ph: "b"/"n"/"e"`` and the request id) →
+    async trace events (``cat: "request"``, ``id`` = request) — every
+    accepted request renders as ONE async track with its phases nested
+    under it, instants for first_token/deadline_exceeded riding the
+    same track;
+  * ``slots`` records → a ``slot_occupancy`` counter track (in-use vs
+    free decode lanes over time);
   * each ``retry`` additionally opens a flow arrow (``ph: "s"`` →
     ``ph: "f"``, ``bp: "e"``) from the retry instant to the END of the
     innermost span open on that host when it fired — the viewer draws
@@ -42,10 +50,14 @@ from progen_tpu.telemetry.goodput import goodput_skew
 # record keys that map onto trace-event structure rather than args
 _STRUCTURAL = {"ev", "span", "id", "ts", "pid", "tid", "thread"}
 
+# req-record keys that map onto async-event structure rather than args
+_REQ_STRUCTURAL = _STRUCTURAL | {"ph", "name", "req"}
+
 # one-shot telemetry records rendered as instant events on the host track
 INSTANT_EVENTS = (
     "retry", "anomaly", "anomaly_rollback", "stall", "stall_escalation",
     "ckpt_quarantine", "ckpt_commit_failed", "chaos", "goodput",
+    "clock_beacon", "request_rejected",
 )
 
 # metrics.jsonl columns that get their own counter track
@@ -54,10 +66,24 @@ _SCALAR_COUNTERS = (
 )
 
 
-def iter_jsonl(path) -> Iterator[dict]:
+class LineDrops:
+    """Tally of torn/garbage lines ``iter_jsonl`` skipped. A trace that
+    quietly lost records is an observability bug, so every CLI surface
+    (export-trace, summarize, stitch) threads one of these through its
+    reads and reports the total."""
+
+    __slots__ = ("count",)
+
+    def __init__(self):
+        self.count = 0
+
+
+def iter_jsonl(path, drops: Optional[LineDrops] = None) -> Iterator[dict]:
     """Parsed records, one per line; a torn final line (the crash-safety
     contract allows exactly one) or stray garbage is skipped, not fatal
-    — a trace of a crashed run is the whole point."""
+    — a trace of a crashed run is the whole point. Skips are counted
+    into ``drops`` so callers can surface how many lines the trace is
+    missing."""
     with Path(path).open() as f:
         for line in f:
             line = line.strip()
@@ -66,9 +92,13 @@ def iter_jsonl(path) -> Iterator[dict]:
             try:
                 rec = json.loads(line)
             except ValueError:
+                if drops is not None:
+                    drops.count += 1
                 continue
             if isinstance(rec, dict):
                 yield rec
+            elif drops is not None:
+                drops.count += 1
 
 
 def _us(ts: float) -> float:
@@ -165,6 +195,28 @@ def build_trace(
                             "ts": _us(ts), "pid": pid, "tid": tid,
                         })
                     break
+        elif ev == "req":
+            # serving request lifecycle: async begin/instant/end keyed
+            # on the request id, one async track per request
+            ph = rec.get("ph")
+            rid = rec.get("req")
+            if ph not in ("b", "n", "e") or rid is None:
+                continue
+            _note_pid(pid)
+            trace_events.append({
+                "ph": ph, "cat": "request",
+                "name": str(rec.get("name", "request")),
+                "id": str(rid), "ts": _us(ts), "pid": pid, "tid": 0,
+                "args": {
+                    k: v for k, v in rec.items()
+                    if k not in _REQ_STRUCTURAL
+                },
+            })
+        elif ev == "slots":
+            _note_pid(pid)
+            trace_events.append(_counter("slot_occupancy", ts, pid, {
+                k: rec[k] for k in ("in_use", "free") if k in rec
+            }))
         elif ev == "goodput_host":
             host = int(rec.get("host", pid))
             _note_pid(host)
@@ -236,11 +288,14 @@ def export_trace(
 ) -> dict:
     """File-to-file convenience used by the CLI: read events.jsonl (and
     metrics.jsonl when present), write Trace Event JSON, return the
-    trace dict."""
+    trace dict. ``progenDroppedLines`` on the result counts torn/
+    garbage lines the readers had to skip."""
+    drops = LineDrops()
     metrics: list = []
     if metrics_path is not None and Path(metrics_path).exists():
-        metrics = list(iter_jsonl(metrics_path))
-    trace = build_trace(iter_jsonl(events_path), metrics)
+        metrics = list(iter_jsonl(metrics_path, drops))
+    trace = build_trace(iter_jsonl(events_path, drops), metrics)
+    trace["progenDroppedLines"] = drops.count
     out_path = Path(out_path)
     out_path.parent.mkdir(parents=True, exist_ok=True)
     with out_path.open("w") as f:

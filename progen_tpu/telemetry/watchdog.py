@@ -41,7 +41,7 @@ import time
 from typing import Callable, Optional
 
 from progen_tpu.telemetry.registry import get_registry
-from progen_tpu.telemetry.spans import Telemetry, get_telemetry
+from progen_tpu.telemetry.spans import Telemetry, get_telemetry, host_index
 
 
 class StallWatchdog:
@@ -154,6 +154,10 @@ class StallWatchdog:
         report = {
             "ev": "stall",
             "ts": time.time(),
+            # explicit host stamp (not just the sink's pid tag): a
+            # fleet-merged trace must pin the stall to the right track
+            # even when the report is read outside the emitting process
+            "host": host_index(),
             "stalled_s": round(stalled_s, 3),
             "deadline_s": self.deadline_s,
             "open_spans": [
@@ -166,7 +170,8 @@ class StallWatchdog:
             ],
         }
         print(
-            f"[stall-watchdog] no step completed in {stalled_s:.1f}s "
+            f"[stall-watchdog] host {report['host']}: no step completed "
+            f"in {stalled_s:.1f}s "
             f"(deadline {self.deadline_s:.0f}s); open spans: "
             f"{[r['span'] for r in report['open_spans']] or ['<none>']}; "
             "all-thread stacks follow",
@@ -211,6 +216,7 @@ class StallWatchdog:
         record = {
             "ev": "stall_escalation",
             "ts": time.time(),
+            "host": host_index(),
             "stalled_s": round(stalled_s, 3),
             "consecutive_reports": self._fires_this_stall,
             "memory_stats": mem,
@@ -220,7 +226,7 @@ class StallWatchdog:
             ],
         }
         print(
-            f"[stall-watchdog] ESCALATION after "
+            f"[stall-watchdog] host {record['host']}: ESCALATION after "
             f"{self._fires_this_stall} consecutive stall reports "
             f"({stalled_s:.1f}s): device memory + open spans snapshotted "
             "to the event stream",

@@ -1,7 +1,16 @@
+from progen_tpu.telemetry.stitch import emit_clock_beacon
 from progen_tpu.training.loss import cross_entropy, masked_mean
 from progen_tpu.training.optimizer import make_optimizer
 from progen_tpu.training.state import TrainState
 from progen_tpu.training.step import make_eval_step, make_train_step
+
+# The step-boundary clock-beacon contract lives with training: the
+# train loop calls ``emit_clock_beacon(step)`` once per optimizer step,
+# immediately AFTER the host sync that observes the step's collective
+# result (the loss fetch behind the gradient all-reduce). That barrier
+# is crossed by every host at (physically) the same moment, so the
+# beacons are the shared reference event ``telemetry.stitch`` aligns
+# per-host clocks on when merging a fleet's event files.
 
 __all__ = [
     "cross_entropy",
@@ -10,4 +19,5 @@ __all__ = [
     "TrainState",
     "make_eval_step",
     "make_train_step",
+    "emit_clock_beacon",
 ]

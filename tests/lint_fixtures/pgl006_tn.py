@@ -18,3 +18,12 @@ def clean_metrics(reg):
 
 def clean_event(emit):
     emit({"ev": "ring_check_vma", "backend": "tpu"})
+
+
+def clean_beacon(emit):
+    emit({"ev": "clock_beacon", "ts": 1.0, "step": 3})
+
+
+def clean_serving_metrics(reg):
+    reg.observe("itl_s", 0.01)
+    reg.set_gauge("slot_occupancy", 2)

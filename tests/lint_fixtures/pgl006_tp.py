@@ -1,4 +1,4 @@
-"""PGL006 true positives: telemetry hygiene. Expected findings: 3."""
+"""PGL006 true positives: telemetry hygiene. Expected findings: 6."""
 
 
 def unbounded_span(telemetry, name):
@@ -12,3 +12,13 @@ def raw_begin_record(emit):
 
 def slash_metric(reg):
     reg.inc("tokens/sec")  # TP: '/' fails the Prometheus name grammar
+
+
+def raw_req_record(emit):
+    # TP: async req record outside serving/scheduler.py
+    emit({"ev": "req", "ph": "b", "name": "queued", "req": "r1"})
+
+
+def bad_async_ph(emit):
+    # TP x2: req record outside the scheduler AND a 'ph' outside b/n/e
+    emit({"ev": "req", "ph": "X", "name": "queued", "req": "r1"})

@@ -55,6 +55,7 @@ from pathlib import Path
 
 from progen_tpu import telemetry
 from progen_tpu.telemetry import GoodputLedger, emit_per_host_goodput
+from progen_tpu.training import emit_clock_beacon
 
 telemetry.configure(
     path=Path(ckpt_dir).parent / f"events_p{process_id}.jsonl"
@@ -89,7 +90,10 @@ with mesh:
             batch = put_batch(local[None], mesh, accum_axis=True)
         with ledger.track("step"):
             state, metrics = step(state, batch)
+        # the loss fetch in the f-string below synced on the step's
+        # all-reduce: beacon the barrier for the stitch clock alignment
         print(f"LOSS {i} {float(metrics['loss']):.6f}", flush=True)
+        emit_clock_beacon(i)
 
     with ledger.track("checkpoint"):
         save(Package(16, state, CFG.to_dict(), "mh-run"))
@@ -102,6 +106,7 @@ with mesh:
     local = next(ds)
     state, metrics = step(state, put_batch(local[None], mesh, accum_axis=True))
     print(f"LOSS 2 {float(metrics['loss']):.6f}", flush=True)
+    emit_clock_beacon(2)
 
 # --- phase 2: tensor parallelism ACROSS hosts — the model axis spans both
 # processes, so every attention/FF block's all-reduce crosses the process

@@ -184,3 +184,42 @@ def test_two_process_training_matches_single(tmp_path):
         if ln.startswith("data") and "straggler host 1" in ln
     ]
     assert straggler_lines, res.output
+
+    # --- fleet stitch: both hosts' event files merge into ONE trace on
+    # a common corrected clock, anchored on the per-step clock_beacon
+    # records each worker emitted after its loss fetch
+    ev1 = tmp_path / "events_p1.jsonl"
+    assert ev1.exists(), "worker 1 left no event stream"
+    stitched = tmp_path / "stitched.json"
+    res = CliRunner().invoke(
+        telemetry_cli,
+        ["stitch", str(ev), str(ev1), "--out", str(stitched)],
+    )
+    assert res.exit_code == 0, res.output
+    assert "clock offset" in res.output
+    trace = json.loads(stitched.read_text())
+    timed = [e for e in trace["traceEvents"] if e["ph"] != "M"]
+    assert timed, "stitched trace has no events"
+    # both host tracks present, corrected timestamps monotone
+    assert {e["pid"] for e in timed} >= {0, 1}
+    ts = [e["ts"] for e in timed]
+    assert ts == sorted(ts)
+    # both hosts aligned: per-host offsets recovered (host 0 = 0 by
+    # construction), beacons for the 3 steps, cross-host arrows
+    assert set(trace["progenClockOffsets"]) == {"0", "1"}
+    assert trace["progenClockOffsets"]["0"] == 0.0
+    beacons = [
+        e for e in timed
+        if e.get("name") == "clock_beacon" and e["ph"] == "X"
+    ]
+    assert {(e["pid"], e["args"]["step"]) for e in beacons} == {
+        (h, s) for h in (0, 1) for s in (0, 1, 2)
+    }
+    flows = [e for e in timed if e.get("name") == "step_sync"]
+    assert len([e for e in flows if e["ph"] == "s"]) == 3
+    assert len([e for e in flows if e["ph"] == "f"]) == 3
+    # fleet goodput skew rode the merged stream: both hosts, host 1
+    # still the data straggler
+    skew = trace["progenGoodputSkew"]
+    assert skew["hosts"] == 2
+    assert skew["data"]["straggler"] == 1

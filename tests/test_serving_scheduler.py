@@ -295,3 +295,168 @@ class TestDeadlines:
         text = prometheus_text(sched.metrics)
         assert "progen_serve_rejected_deadline_exceeded_total 1" in text
         assert "progen_serve_requests_expired_total 1" in text
+
+
+class TestRequestTracing:
+    """Per-request async spans: every accepted request becomes one async
+    track (b/e request with nested queued/prefill/decode phases and a
+    first_token instant) in the global telemetry stream, rejects become
+    instants, and slot occupancy rides a counter series."""
+
+    @pytest.fixture()
+    def records(self):
+        from progen_tpu.telemetry import spans
+
+        seen = []
+        spans.configure(sink=seen.append)
+        try:
+            yield seen
+        finally:
+            spans.configure()  # detach the global sink
+
+    @staticmethod
+    def _reqs(records, rid=None):
+        out = [r for r in records if r.get("ev") == "req"]
+        return out if rid is None else [r for r in out if r["req"] == rid]
+
+    def test_accepted_request_is_one_closed_async_track(
+        self, model_and_params, records
+    ):
+        model, params = model_and_params
+        engine = ServeEngine(model, params, max_slots=1, max_len=32)
+        sched = Scheduler(engine, max_queue=4)
+        for i in range(2):
+            assert sched.submit(_req(i, length=6))[0]
+        sched.run_to_completion(max_steps=300)
+        for rid in ("q0", "q1"):
+            evs = self._reqs(records, rid)
+            phases = {}
+            for r in evs:
+                phases.setdefault(r["name"], []).append(r["ph"])
+            # the four phases each open exactly once and close
+            for name in ("request", "queued", "prefill", "decode"):
+                assert phases[name] == ["b", "e"], (rid, name, phases)
+            assert phases["first_token"] == ["n"]
+            # timestamps are wall-clock and non-decreasing per request
+            ts = [r["ts"] for r in evs]
+            assert ts == sorted(ts)
+        # request args: b request carries length, e request the yield
+        done = [
+            r for r in self._reqs(records, "q0")
+            if r["name"] == "request" and r["ph"] == "e"
+        ]
+        assert done[0]["n_generated"] > 0
+        # the prefill slice itself ran under a serve/prefill span
+        # stamped with the request id (engine-side attribution)
+        prefill_spans = [
+            r for r in records
+            if r.get("ev") == "B" and r.get("span") == "serve/prefill"
+        ]
+        assert {r["request_id"] for r in prefill_spans} == {"q0", "q1"}
+
+    def test_expired_request_track_closes_with_reason(
+        self, model_and_params, records
+    ):
+        from progen_tpu.serving import REJECT_DEADLINE
+
+        model, params = model_and_params
+        clock = {"t": 0.0}
+        engine = ServeEngine(model, params, max_slots=1, max_len=32)
+        sched = Scheduler(engine, max_queue=8, clock=lambda: clock["t"])
+        assert sched.submit(_req(0, length=12))[0]
+        assert sched.submit(_req(1, length=4, deadline_s=5.0))[0]
+        sched.step()  # r0 takes the only slot
+        clock["t"] = 10.0
+        sched.step()  # r1 expires while queued
+        evs = self._reqs(records, "q1")
+        phs = [(r["ph"], r["name"]) for r in evs]
+        assert ("n", REJECT_DEADLINE) in phs
+        assert phs[-2:] == [("e", "queued"), ("e", "request")]
+        closing = evs[-1]
+        assert closing["reason"] == REJECT_DEADLINE
+        # it never reached a slot: no prefill/decode phases
+        assert not any(r["name"] in ("prefill", "decode") for r in evs)
+
+    def test_submit_rejects_are_instants_not_tracks(
+        self, model_and_params, records
+    ):
+        model, params = model_and_params
+        engine = ServeEngine(model, params, max_slots=1, max_len=32)
+        sched = Scheduler(engine, max_queue=1)
+        assert sched.submit(_req(0, length=6))[0]
+        ok, reason = sched.submit(_req(1, length=6))  # queue_full
+        assert not ok and reason == REJECT_QUEUE_FULL
+        sched.submit(_req(2, length=99))  # invalid
+        rejects = [
+            r for r in records if r.get("ev") == "request_rejected"
+        ]
+        assert [(r["req"], r["reason"]) for r in rejects] == [
+            ("q1", REJECT_QUEUE_FULL), ("q2", "invalid")
+        ]
+        # a rejected submit never opened an async track
+        assert self._reqs(records, "q1") == []
+        assert self._reqs(records, "q2") == []
+
+    def test_slot_occupancy_counter_series(
+        self, model_and_params, records
+    ):
+        model, params = model_and_params
+        engine = ServeEngine(model, params, max_slots=2, max_len=32)
+        sched = Scheduler(engine, max_queue=8)
+        for i in range(3):
+            assert sched.submit(_req(i, length=6))[0]
+        sched.run_to_completion(max_steps=300)
+        slots = [r for r in records if r.get("ev") == "slots"]
+        assert slots, "no slot-occupancy records emitted"
+        # every sample is internally consistent with the pool size
+        for r in slots:
+            assert r["in_use"] + r["free"] == 2
+            assert 0 <= r["in_use"] <= 2
+        # emitted on change only: no consecutive duplicates
+        series = [r["in_use"] for r in slots]
+        assert all(a != b for a, b in zip(series, series[1:]))
+        assert series[-1] == 0  # drained pool at completion
+
+    def test_itl_observed_per_inter_token_gap(
+        self, model_and_params, records
+    ):
+        model, params = model_and_params
+        engine = ServeEngine(model, params, max_slots=1, max_len=32)
+        sched = Scheduler(engine, max_queue=2)
+        assert sched.submit(_req(0, length=10))[0]
+        sched.run_to_completion(max_steps=300)
+        m = sched.metrics.snapshot()
+        done = [
+            r for r in self._reqs(records, "q0")
+            if r["name"] == "request" and r["ph"] == "e"
+        ]
+        n_generated = done[0]["n_generated"]
+        # one gap per consecutive token pair of the single request
+        assert m["itl_s_count"] == n_generated - 1
+        assert m["ttft_s_count"] == 1
+
+    def test_itl_quantiles_in_prometheus_exposition(
+        self, model_and_params
+    ):
+        from progen_tpu.telemetry import prometheus_text
+
+        model, params = model_and_params
+        engine = ServeEngine(model, params, max_slots=1, max_len=32)
+        sched = Scheduler(engine, max_queue=2)
+        # declared at construction: a FRESH scheduler already exposes
+        # the summary family at zero (absent family = broken exporter)
+        text0 = prometheus_text(sched.metrics)
+        assert "progen_serve_itl_seconds_count 0" in text0
+        assert 'progen_serve_itl_seconds{quantile="0.5"} 0' in text0
+        assert "progen_serve_ttft_seconds_count 0" in text0
+        assert "progen_serve_latency_seconds_count 0" in text0
+        assert sched.submit(_req(0, length=10))[0]
+        sched.run_to_completion(max_steps=300)
+        text = prometheus_text(sched.metrics)
+        for q in ("0.5", "0.95", "0.99"):
+            assert f'progen_serve_itl_seconds{{quantile="{q}"}}' in text
+        count = [
+            ln for ln in text.splitlines()
+            if ln.startswith("progen_serve_itl_seconds_count")
+        ]
+        assert count and float(count[0].split()[1]) > 0

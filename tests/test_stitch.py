@@ -1,0 +1,253 @@
+"""Fleet stitching: clock-offset recovery, stream merging, the stitch
+CLI. All synthetic and jax-free — two fake hosts with a KNOWN clock skew
+must come out aligned within tolerance (ISSUE 7 acceptance: 1ms on a
+synthetic known-skew fixture)."""
+
+import json
+
+from click.testing import CliRunner
+
+from progen_tpu.cli.telemetry import main as telemetry_cli
+from progen_tpu.telemetry.stitch import (
+    clock_offsets,
+    collect_beacons,
+    emit_clock_beacon,
+    stitch_streams,
+    stitch_trace,
+    stream_host,
+)
+
+# deterministic sub-ms "NTP jitter" per step, well inside the 1ms
+# acceptance tolerance
+_JITTER = [0.0002, -0.0003, 0.0001, -0.0002, 0.0004, -0.0001]
+
+
+def _host_stream(host, skew, steps=6, base=1000.0, span_s=0.05):
+    """One host's parsed events.jsonl: per step a B/E span pair and a
+    clock_beacon, all timestamped on a clock running ``skew`` seconds
+    ahead of true time."""
+    out = []
+    for s in range(steps):
+        true_t = base + s * 1.0
+        # host-dependent jitter phase so the two hosts' noise does not
+        # cancel and the median has real work to do
+        t = true_t + skew + _JITTER[(s + host) % len(_JITTER)]
+        out.append({
+            "ev": "B", "span": "train/step", "id": s, "ts": t - span_s,
+            "pid": host, "tid": 1, "thread": "main",
+        })
+        out.append({
+            "ev": "E", "span": "train/step", "id": s, "ts": t,
+            "dur_s": span_s, "pid": host, "tid": 1, "thread": "main",
+        })
+        out.append({
+            "ev": "clock_beacon", "ts": t, "step": s, "pid": host,
+        })
+    return out
+
+
+class TestClockOffsets:
+    def test_known_skew_recovered_within_1ms(self):
+        skew = 0.350
+        beacons = collect_beacons(
+            _host_stream(0, 0.0) + _host_stream(1, skew)
+        )
+        offsets = clock_offsets(beacons)
+        assert offsets[0] == 0.0
+        assert abs(offsets[1] - skew) < 1e-3
+
+    def test_median_robust_to_straggler_step(self):
+        # one step where host 1 genuinely lagged the barrier by 5s must
+        # not bend the clock: the median ignores the outlier
+        stream1 = _host_stream(1, 0.2)
+        for rec in stream1:
+            if rec.get("ev") == "clock_beacon" and rec["step"] == 3:
+                rec["ts"] += 5.0
+        beacons = collect_beacons(_host_stream(0, 0.0) + stream1)
+        offsets = clock_offsets(beacons)
+        assert abs(offsets[1] - 0.2) < 1e-3
+
+    def test_negative_skew(self):
+        beacons = collect_beacons(
+            _host_stream(0, 0.0) + _host_stream(1, -1.5)
+        )
+        assert abs(clock_offsets(beacons)[1] + 1.5) < 1e-3
+
+    def test_no_shared_steps_keeps_zero_offset(self):
+        beacons = {0: {0: 100.0, 1: 101.0}, 1: {7: 900.0, 8: 901.0}}
+        offsets = clock_offsets(beacons)
+        assert offsets == {0: 0.0, 1: 0.0}
+
+    def test_missing_reference_falls_back_to_min_host(self):
+        beacons = collect_beacons(
+            _host_stream(1, 0.0) + _host_stream(2, 0.1)
+        )
+        offsets = clock_offsets(beacons, reference=0)
+        assert offsets[1] == 0.0
+        assert abs(offsets[2] - 0.1) < 1e-3
+
+    def test_empty(self):
+        assert clock_offsets({}) == {}
+
+
+class TestEmitClockBeacon:
+    def test_record_shape_and_sink(self):
+        seen = []
+        rec = emit_clock_beacon(7, emit=seen.append)
+        assert seen == [rec]
+        assert rec["ev"] == "clock_beacon"
+        assert rec["step"] == 7
+        assert isinstance(rec["ts"], float)
+
+
+class TestStreamHost:
+    def test_majority_pid(self):
+        assert stream_host(_host_stream(1, 0.0)) == 1
+
+    def test_default_when_unstamped(self):
+        assert stream_host([{"ev": "x", "ts": 1.0}], default=3) == 3
+
+
+class TestStitchStreams:
+    def test_aligned_monotone_with_both_tracks(self):
+        skew = 2.0
+        trace = stitch_streams(
+            [_host_stream(0, 0.0), _host_stream(1, skew)]
+        )
+        timed = [e for e in trace["traceEvents"] if e["ph"] != "M"]
+        assert {e["pid"] for e in timed} == {0, 1}
+        ts = [e["ts"] for e in timed]
+        assert ts == sorted(ts)
+        # the corrected step-N span ends land within 1ms of each other
+        # (without correction they'd be 2s apart)
+        by_pid = {}
+        for e in timed:
+            if e["ph"] == "E":
+                by_pid.setdefault(e["pid"], []).append(e["ts"])
+        assert len(by_pid[0]) == len(by_pid[1]) == 6
+        for t0, t1 in zip(by_pid[0], by_pid[1]):
+            assert abs(t0 - t1) < 1e-3 * 1e6  # trace ts are microseconds
+
+    def test_offsets_reported(self):
+        trace = stitch_streams(
+            [_host_stream(0, 0.0), _host_stream(1, 0.5)]
+        )
+        offs = trace["progenClockOffsets"]
+        assert set(offs) == {"0", "1"}
+        assert offs["0"] == 0.0
+        assert abs(offs["1"] - 0.5) < 1e-3
+
+    def test_beacon_anchors_and_flow_arrows(self):
+        trace = stitch_streams(
+            [_host_stream(0, 0.0), _host_stream(1, 0.5)]
+        )
+        timed = [e for e in trace["traceEvents"] if e["ph"] != "M"]
+        anchors = [e for e in timed if e.get("name") == "clock_beacon"]
+        assert all(e["ph"] == "X" for e in anchors)
+        assert {(e["pid"], e["args"]["step"]) for e in anchors} == {
+            (h, s) for h in (0, 1) for s in range(6)
+        }
+        starts = [
+            e for e in timed
+            if e.get("name") == "step_sync" and e["ph"] == "s"
+        ]
+        finishes = [
+            e for e in timed
+            if e.get("name") == "step_sync" and e["ph"] == "f"
+        ]
+        assert len(starts) == len(finishes) == 6
+        assert all(e["pid"] == 0 for e in starts)
+        assert all(e["pid"] == 1 for e in finishes)
+        assert trace["progenStitch"]["flow_arrows"] == 6
+
+    def test_goodput_host_deduped_fleet_skew(self):
+        # both hosts emit the FULL 2-host table (allgather contract);
+        # the stitcher must not double-count
+        table = [
+            {"ev": "goodput_host", "ts": 1007.0, "host": 0, "pid": 0,
+             "goodput_pct": 90.0, "bucket_s/data": 0.1, "wall_s": 6.0},
+            {"ev": "goodput_host", "ts": 1007.0, "host": 1, "pid": 0,
+             "goodput_pct": 80.0, "bucket_s/data": 0.6, "wall_s": 6.0},
+        ]
+        s0 = _host_stream(0, 0.0) + table
+        s1 = _host_stream(1, 0.3) + [
+            {**rec, "pid": 1} for rec in table
+        ]
+        trace = stitch_streams([s0, s1])
+        skew = trace["progenGoodputSkew"]
+        assert skew["hosts"] == 2
+        assert skew["data"]["straggler"] == 1
+        gp = [
+            e for e in trace["traceEvents"]
+            if e.get("name") == "goodput_pct"
+        ]
+        assert len(gp) == 2  # one counter sample per host, not four
+
+    def test_no_beacons_merges_uncorrected(self):
+        s0 = [r for r in _host_stream(0, 0.0)
+              if r.get("ev") != "clock_beacon"]
+        s1 = [r for r in _host_stream(1, 1.0)
+              if r.get("ev") != "clock_beacon"]
+        trace = stitch_streams([s0, s1])
+        assert trace["progenClockOffsets"] == {}
+        timed = [e for e in trace["traceEvents"] if e["ph"] != "M"]
+        assert {e["pid"] for e in timed} == {0, 1}
+
+    def test_metrics_rows_corrected_and_pid_stamped(self):
+        rows = [{"_time": 1002.5 + 0.4, "step_ms": 12.0}]
+        trace = stitch_streams(
+            [_host_stream(0, 0.0), _host_stream(1, 0.4)],
+            metrics_streams=[(1, rows)],
+        )
+        counters = [
+            e for e in trace["traceEvents"]
+            if e.get("name") == "step_ms"
+        ]
+        assert len(counters) == 1
+        assert counters[0]["pid"] == 1
+        assert abs(counters[0]["ts"] - 1002.5 * 1e6) < 1e-3 * 1e6
+
+
+class TestStitchFiles:
+    def _write(self, path, records, torn=False):
+        with path.open("w") as f:
+            for rec in records:
+                f.write(json.dumps(rec) + "\n")
+            if torn:
+                f.write('{"ev": "B", "span": "tor')
+
+    def test_stitch_trace_writes_valid_json(self, tmp_path):
+        p0, p1 = tmp_path / "e0.jsonl", tmp_path / "e1.jsonl"
+        self._write(p0, _host_stream(0, 0.0))
+        self._write(p1, _host_stream(1, 0.25), torn=True)
+        out = tmp_path / "stitched.json"
+        trace = stitch_trace([p0, p1], out_path=out)
+        assert out.exists()
+        on_disk = json.loads(out.read_text())
+        assert on_disk["progenClockOffsets"] == trace["progenClockOffsets"]
+        assert trace["progenDroppedLines"] == 1
+        assert abs(float(trace["progenClockOffsets"]["1"]) - 0.25) < 1e-3
+
+    def test_cli_stitch(self, tmp_path):
+        p0, p1 = tmp_path / "e0.jsonl", tmp_path / "e1.jsonl"
+        self._write(p0, _host_stream(0, 0.0), torn=True)
+        self._write(p1, _host_stream(1, 0.5))
+        res = CliRunner().invoke(
+            telemetry_cli, ["stitch", str(p0), str(p1)]
+        )
+        assert res.exit_code == 0, res.output
+        assert "host 1: clock offset" in res.output
+        assert "+500." in res.output  # ~+500ms reported
+        assert "skipped 1 torn/garbage line" in res.output
+        assert (tmp_path / "stitched_trace.json").exists()
+
+    def test_cli_stitch_no_beacons(self, tmp_path):
+        p0 = tmp_path / "e0.jsonl"
+        self._write(
+            p0,
+            [r for r in _host_stream(0, 0.0)
+             if r.get("ev") != "clock_beacon"],
+        )
+        res = CliRunner().invoke(telemetry_cli, ["stitch", str(p0)])
+        assert res.exit_code == 0, res.output
+        assert "no clock_beacon records" in res.output

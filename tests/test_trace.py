@@ -18,7 +18,7 @@ from progen_tpu.telemetry import (
     goodput_skew,
     per_host_reports,
 )
-from progen_tpu.telemetry.trace import iter_jsonl
+from progen_tpu.telemetry.trace import LineDrops, iter_jsonl
 
 
 # ------------------------------------------------------- trace building
@@ -347,3 +347,163 @@ class TestRetryFlowEvents:
             if e.get("cat") == "flow"
         ]
         assert flows == []  # host 1's retry can't bill host 0's span
+
+
+# ------------------------------------------- per-request async events
+
+
+def _request_lifecycle(rid="r-1", pid=0, t0=100.0):
+    """The record sequence the serving scheduler emits for one accepted
+    request: nested async phases under a parent "request" track."""
+    return [
+        {"ev": "req", "ph": "b", "name": "request", "req": rid,
+         "ts": t0, "pid": pid, "length": 16},
+        {"ev": "req", "ph": "b", "name": "queued", "req": rid,
+         "ts": t0, "pid": pid},
+        {"ev": "req", "ph": "e", "name": "queued", "req": rid,
+         "ts": t0 + 0.01, "pid": pid},
+        {"ev": "req", "ph": "b", "name": "prefill", "req": rid,
+         "ts": t0 + 0.01, "pid": pid, "slot": 2},
+        {"ev": "req", "ph": "e", "name": "prefill", "req": rid,
+         "ts": t0 + 0.05, "pid": pid},
+        {"ev": "req", "ph": "b", "name": "decode", "req": rid,
+         "ts": t0 + 0.05, "pid": pid, "slot": 2},
+        {"ev": "req", "ph": "n", "name": "first_token", "req": rid,
+         "ts": t0 + 0.06, "pid": pid},
+        {"ev": "req", "ph": "e", "name": "decode", "req": rid,
+         "ts": t0 + 0.20, "pid": pid},
+        {"ev": "req", "ph": "e", "name": "request", "req": rid,
+         "ts": t0 + 0.20, "pid": pid, "n_generated": 8},
+    ]
+
+
+class TestRequestAsyncEvents:
+    def test_req_records_map_to_async_events(self):
+        trace = build_trace(_request_lifecycle(rid=7, pid=1))
+        reqs = [
+            e for e in trace["traceEvents"]
+            if e.get("cat") == "request"
+        ]
+        assert len(reqs) == 9
+        # every async event carries the stringified request id, rides
+        # the emitting host's pid, and keeps microsecond timestamps
+        assert {e["id"] for e in reqs} == {"7"}
+        assert {e["pid"] for e in reqs} == {1}
+        assert all(e["ph"] in ("b", "n", "e") for e in reqs)
+        assert reqs[0]["ts"] == pytest.approx(100.0 * 1e6)
+        # attrs ride args; structural keys (ev/ph/name/req/ts/pid) don't
+        assert reqs[0]["args"] == {"length": 16}
+        assert all("req" not in e["args"] for e in reqs)
+        by_name = {}
+        for e in reqs:
+            by_name.setdefault(e["name"], []).append(e["ph"])
+        assert by_name["request"] == ["b", "e"]
+        assert by_name["queued"] == ["b", "e"]
+        assert by_name["prefill"] == ["b", "e"]
+        assert by_name["decode"] == ["b", "e"]
+        assert by_name["first_token"] == ["n"]
+
+    def test_every_b_has_matching_e(self):
+        # two interleaved requests: per (id, name) the phases pair up
+        events = sorted(
+            _request_lifecycle("a", t0=100.0)
+            + _request_lifecycle("b", t0=100.005),
+            key=lambda r: r["ts"],
+        )
+        reqs = [
+            e for e in build_trace(events)["traceEvents"]
+            if e.get("cat") == "request"
+        ]
+        open_phases = {}
+        for e in reqs:
+            key = (e["id"], e["name"])
+            if e["ph"] == "b":
+                assert key not in open_phases, f"double-open {key}"
+                open_phases[key] = e
+            elif e["ph"] == "e":
+                assert key in open_phases, f"e without b {key}"
+                del open_phases[key]
+        assert open_phases == {}
+
+    def test_crash_truncated_stream_still_builds(self):
+        # SIGKILL mid-decode: the unmatched b's still render (the
+        # viewer shows them running to the end of the trace) and the
+        # builder must not raise
+        events = _request_lifecycle()[:6]  # ends inside b decode
+        trace = build_trace(events)
+        reqs = [
+            e for e in trace["traceEvents"]
+            if e.get("cat") == "request"
+        ]
+        assert [e["ph"] for e in reqs] == ["b", "b", "e", "b", "e", "b"]
+
+    def test_malformed_req_records_skipped(self):
+        trace = build_trace([
+            {"ev": "req", "ph": "X", "name": "queued", "req": 1,
+             "ts": 1.0, "pid": 0},  # bad phase
+            {"ev": "req", "ph": "b", "name": "queued",
+             "ts": 1.0, "pid": 0},  # no request id
+        ])
+        assert [
+            e for e in trace["traceEvents"] if e.get("cat") == "request"
+        ] == []
+
+    def test_request_rejected_renders_as_instant(self):
+        trace = build_trace([
+            {"ev": "request_rejected", "ts": 5.0, "pid": 0,
+             "req": "r9", "reason": "queue_full"},
+        ])
+        inst = [
+            e for e in trace["traceEvents"]
+            if e["ph"] == "i" and e["name"] == "request_rejected"
+        ]
+        assert len(inst) == 1
+        assert inst[0]["args"]["reason"] == "queue_full"
+
+    def test_slots_records_render_as_counter(self):
+        trace = build_trace([
+            {"ev": "slots", "ts": 1.0, "pid": 0, "in_use": 3,
+             "free": 1},
+            {"ev": "slots", "ts": 2.0, "pid": 0, "in_use": 0,
+             "free": 4},
+        ])
+        counters = [
+            e for e in trace["traceEvents"]
+            if e["ph"] == "C" and e["name"] == "slot_occupancy"
+        ]
+        assert len(counters) == 2
+        assert counters[0]["args"] == {"in_use": 3, "free": 1}
+        assert counters[1]["args"] == {"in_use": 0, "free": 4}
+
+
+# --------------------------------------------------- torn-line counting
+
+
+def test_iter_jsonl_counts_drops(tmp_path):
+    p = tmp_path / "ev.jsonl"
+    p.write_text(
+        '{"ev": "B", "span": "a", "id": 0, "ts": 1.0}\n'
+        "garbage line\n"
+        "[0]\n"
+        '{"ev": "E", "span": "a", "id": 0, "ts": 2.0, "dur_s": 1.0}\n'
+        '{"ev": "E", "span": "b", "tr'  # torn final line
+    )
+    drops = LineDrops()
+    recs = list(iter_jsonl(p, drops))
+    assert [r["ev"] for r in recs] == ["B", "E"]
+    assert drops.count == 3
+
+
+def test_export_trace_reports_dropped_lines(tmp_path):
+    from progen_tpu.telemetry.trace import export_trace
+
+    ev = tmp_path / "events.jsonl"
+    with ev.open("w") as f:
+        for rec in _sample_events():
+            f.write(json.dumps(rec) + "\n")
+        f.write('{"ev": "B", "sp')  # torn tail
+    trace = export_trace(ev, tmp_path / "trace.json")
+    assert trace["progenDroppedLines"] == 1
+    assert json.loads(
+        (tmp_path / "trace.json").read_text()
+    )["progenDroppedLines"] == 1
