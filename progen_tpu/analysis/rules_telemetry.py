@@ -26,7 +26,16 @@ dashboard query then matches nothing. Three checks:
     queued/prefill/decode phase grammar and the every-``b``-gets-its-
     ``e`` exception-safety burden (same reasoning as B/E ↔ spans.py),
     and a literal ``"ph"`` in a req record must be one of
-    ``"b"``/``"n"``/``"e"`` (the async trace-event alphabet).
+    ``"b"``/``"n"``/``"e"`` (the async trace-event alphabet);
+  * raw ``"ev": "journal"`` records must not be emitted outside
+    ``serving/journal.py`` — the replay journal's ``op`` grammar
+    (``accept``/``token``/``done``) IS the crash-recovery contract
+    (a free-hand record replay can't parse is silently lost work), and
+    a literal ``"op"`` must come from that alphabet;
+  * raw ``"ev": "reload"`` records must not be emitted outside
+    ``serving/reload.py``, and a literal ``"status"`` must be one of
+    ``staged``/``committed``/``rejected`` — the zero-downtime smoke in
+    CI greps these to assert a reload fully applied or fully didn't.
 """
 
 from __future__ import annotations
@@ -60,6 +69,9 @@ class TelemetryHygieneRule(Rule):
         return self.ctx.path.replace("\\", "/").endswith(
             "serving/scheduler.py"
         )
+
+    def _in_module(self, tail: str) -> bool:
+        return self.ctx.path.replace("\\", "/").endswith(tail)
 
     def _enclosing_params(self, node) -> set:
         fn = self.ctx.enclosing_function(node)
@@ -134,6 +146,38 @@ class TelemetryHygieneRule(Rule):
                         "Scheduler, not hand-rolled records",
                     )
                 self._check_req_ph(d)
+            elif v.value == "journal":
+                if not self._in_module("serving/journal.py"):
+                    self.report(
+                        v,
+                        "raw journal record emitted outside "
+                        "serving/journal.py — the replay journal's op "
+                        "grammar is the crash-recovery contract; go "
+                        "through RequestJournal, not hand-rolled "
+                        "records",
+                    )
+                self._check_literal_member(
+                    d, "op", ("accept", "token", "done"),
+                    "journal record 'op'",
+                    "replay_requests drops records it can't parse — "
+                    "an unknown op is silently lost work",
+                )
+            elif v.value == "reload":
+                if not self._in_module("serving/reload.py"):
+                    self.report(
+                        v,
+                        "raw reload record emitted outside "
+                        "serving/reload.py — reload status records are "
+                        "what the zero-downtime smoke asserts on; go "
+                        "through WeightReloader, not hand-rolled "
+                        "records",
+                    )
+                self._check_literal_member(
+                    d, "status", ("staged", "committed", "rejected"),
+                    "reload record 'status'",
+                    "anything else reads as a torn reload to the "
+                    "zero-downtime tooling",
+                )
             elif not _PROM_NAME_RE.match(v.value):
                 self.report(
                     v,
@@ -153,6 +197,21 @@ class TelemetryHygieneRule(Rule):
                     f"events only use 'b' (begin), 'n' (instant), "
                     f"'e' (end); anything else is dropped by the "
                     f"trace builder",
+                )
+
+    def _check_literal_member(self, d: ast.Dict, field: str,
+                              allowed: tuple, what: str,
+                              why: str) -> None:
+        """A literal ``field`` value in the record must come from the
+        ``allowed`` alphabet (non-literals are the emitter's problem)."""
+        for k, v in zip(d.keys, d.values):
+            if not (_str_const(k) and k.value == field):
+                continue
+            if _str_const(v) and v.value not in allowed:
+                self.report(
+                    v,
+                    f"{what} is '{v.value}' — must be one of "
+                    f"{'/'.join(allowed)}: {why}",
                 )
 
     def _check_prom_name(self, node, name: str) -> None:

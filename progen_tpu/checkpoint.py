@@ -129,6 +129,11 @@ class Package(NamedTuple):
     model_config: dict
     run_id: Optional[str]
     train_config: Optional[dict] = None
+    # which checkpoint directory the restore walk actually selected —
+    # the hot-reload path compares this against the checkpoint it is
+    # already serving (a corrupt newest quarantined by the fallback walk
+    # must not be mistaken for "new weights arrived")
+    path: Optional[str] = None
 
 
 def _is_gcs(path: str) -> bool:
@@ -465,6 +470,7 @@ def get_checkpoint_fns(
             model_config=meta["model_config"],
             run_id=meta["run_id"],
             train_config=meta.get("train_config"),
+            path=str(last),
         )
 
     def get_last(abstract_state: Any = None) -> Optional[Package]:
@@ -536,6 +542,7 @@ def get_checkpoint_fns(
             model_config=meta["model_config"],
             run_id=meta["run_id"],
             train_config=meta.get("train_config"),
+            path=str(last),
         )
 
     def restore_params(abstract_params: Any = None) -> Optional[Package]:
@@ -554,13 +561,14 @@ def get_checkpoint_fns(
         sel = _select_last()
         if sel is None:
             return None
-        _, meta = sel
+        last, meta = sel
         return Package(
             next_seq_index=meta["next_seq_index"],
             state=None,
             model_config=meta["model_config"],
             run_id=meta["run_id"],
             train_config=meta.get("train_config"),
+            path=str(last),
         )
 
     get_last.peek = peek_last  # exposed without widening the triple

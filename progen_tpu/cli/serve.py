@@ -21,6 +21,16 @@ Two transports, same protocol:
   * --socket PATH: a unix domain socket server; each connection
     submits requests and receives exactly its own events.
 
+Zero-downtime ops (see README "Zero-downtime ops"):
+  * SIGHUP hot-reloads the newest verified checkpoint in a background
+    thread and swaps it in between decode steps — zero recompiles,
+    zero dropped requests; ``--reload_watch N`` polls the checkpoint
+    dir every N seconds and reloads automatically;
+  * ``--journal_dir DIR`` journals accepted requests + emitted tokens
+    to DIR/journal.jsonl; after a crash, ``--replay DIR`` resumes every
+    unfinished accepted request bit-identically (dedup on request id —
+    completed work is never re-emitted).
+
 Run: python -m progen_tpu.cli.serve --max-slots 8 --max-queue 64
 """
 
@@ -112,7 +122,9 @@ def _events_to_lines(events, completions, starts):
 
 
 def _build(checkpoint_path, max_slots, max_len, max_queue,
-           quantize_int8=False):
+           quantize_int8=False, journal=None):
+    import os.path
+
     from progen_tpu.checkpoint import get_checkpoint_fns
     from progen_tpu.config import ProGenConfig
     from progen_tpu.models.progen import ProGen
@@ -137,7 +149,9 @@ def _build(checkpoint_path, max_slots, max_len, max_queue,
             f"calib logits max-abs-err {r['logits_max_abs_err']:.3g}",
             file=sys.stderr,
         )
-    return Scheduler(engine, max_queue=max_queue), engine
+    ckpt_name = os.path.basename(pkg.path) if pkg.path else None
+    sched = Scheduler(engine, max_queue=max_queue, journal=journal)
+    return sched, engine, ckpt_name
 
 
 @click.command()
@@ -175,10 +189,24 @@ def _build(checkpoint_path, max_slots, max_len, max_queue,
 @click.option("--prom_port", default=0,
               help="serve Prometheus text exposition over HTTP on this "
                    "localhost port (0 = off)")
+@click.option("--journal_dir", default=None, type=str,
+              help="journal accepted requests + emitted tokens to "
+                   "DIR/journal.jsonl (crash-safe, append-only) so a "
+                   "later --replay loses zero accepted work")
+@click.option("--replay", "replay_dir", default=None, type=str,
+              help="on startup, replay DIR/journal.jsonl: resume every "
+                   "accepted-but-unfinished request bit-identically "
+                   "(dedup on request id; finished work is settled, "
+                   "never re-decoded)")
+@click.option("--reload_watch", default=0.0, type=float,
+              help="poll the checkpoint dir every N seconds and "
+                   "hot-reload when a new complete checkpoint appears "
+                   "(0 = off; SIGHUP always triggers a reload)")
 def main(checkpoint_path, max_slots, max_queue, max_len, quantize_int8,
          top_k, temperature, top_p, seed, socket_path, metrics_every,
-         prom_file, prom_port):
+         prom_file, prom_port, journal_dir, replay_dir, reload_watch):
     from progen_tpu import telemetry
+    from progen_tpu.resilience.chaos import install_from_env
     from progen_tpu.telemetry import (
         prometheus_text,
         start_prometheus_server,
@@ -186,8 +214,20 @@ def main(checkpoint_path, max_slots, max_queue, max_len, quantize_int8,
     )
     from progen_tpu.tracking import make_tracker
 
-    sched, engine = _build(checkpoint_path, max_slots, max_len, max_queue,
-                           quantize_int8=quantize_int8)
+    # serving chaos sites (serve/prefill, serve/decode, serve/reload*)
+    # arm from the environment, same as cli/train.py — the serve
+    # kill-matrix drives this process via PROGEN_CHAOS alone
+    install_from_env()
+
+    journal = None
+    if journal_dir:
+        from progen_tpu.serving import RequestJournal
+
+        journal = RequestJournal(os.path.join(journal_dir, "journal.jsonl"))
+    sched, engine, ckpt_name = _build(
+        checkpoint_path, max_slots, max_len, max_queue,
+        quantize_int8=quantize_int8, journal=journal,
+    )
     defaults = {
         "length": engine.max_len, "top_k": top_k,
         "temperature": temperature, "top_p": top_p, "seed": seed,
@@ -223,14 +263,75 @@ def main(checkpoint_path, max_slots, max_queue, max_len, quantize_int8,
         )
     print(
         f"serving: max_slots={engine.max_slots} max_len={engine.max_len} "
-        f"max_queue={sched.max_queue}",
+        f"max_queue={sched.max_queue}"
+        + (f" checkpoint={ckpt_name}" if ckpt_name else ""),
         file=sys.stderr,
     )
+
+    # hot weight reload: SIGHUP (or the --reload_watch poller) stages
+    # the newest verified checkpoint on a background thread; tick()
+    # commits it between decode steps — zero recompiles, zero drops
+    from progen_tpu.serving import WeightReloader
+
+    reloader = WeightReloader(
+        engine, checkpoint_path, metrics=sched.metrics, current=ckpt_name
+    )
+    reload_req = {"flag": False}
+
+    def tick():
+        """Once per serve-loop iteration, between decode steps."""
+        if reload_req["flag"]:
+            reload_req["flag"] = False
+            if reloader.request_reload():
+                print("reload: loading newest checkpoint in background",
+                      file=sys.stderr)
+        if reload_watch:
+            reloader.poll_watch(reload_watch)
+        name = reloader.maybe_commit()
+        if name is not None:
+            print(f"reload: now serving {name}", file=sys.stderr)
+        elif reloader.last_error is not None:
+            print(f"reload: rejected ({reloader.last_error}) — still "
+                  f"serving {reloader.current}", file=sys.stderr)
+            reloader.last_error = None
+
+    # crash recovery: resume the previous process's unfinished accepted
+    # requests before opening intake. Requests whose journaled stream
+    # already hit its stop rule are settled here (done event, no decode)
+    replayed_lines = []
+    starts0 = {}
+    if replay_dir:
+        from progen_tpu.data.tokenizer import decode_tokens
+        from progen_tpu.serving import replay_into
+
+        jpath = os.path.join(replay_dir, "journal.jsonl")
+        if os.path.exists(jpath):
+            summary = replay_into(sched, jpath)
+            for req in summary["resumed"]:
+                starts0[req.id] = len(req.prime) + (1 if req.add_bos else 0)
+            for f in summary["finished"]:
+                replayed_lines.append(json.dumps({
+                    "event": "done", "id": f["id"],
+                    "text": decode_tokens(f["emitted"]),
+                    "n_generated": 0, "ttft_s": 0.0, "latency_s": 0.0,
+                    "replayed": True,
+                }))
+            print(
+                f"replay: resumed {len(summary['resumed'])} request(s), "
+                f"settled {len(summary['finished'])} already-finished, "
+                f"skipped {summary['skipped_done']} done "
+                f"({summary['dropped_lines']} torn journal line(s))",
+                file=sys.stderr,
+            )
+        else:
+            print(f"replay: no journal at {jpath}", file=sys.stderr)
 
     # graceful drain: the FIRST SIGTERM/SIGINT closes intake — queued
     # requests are shed as 'rejected: draining', in-flight slots decode
     # to completion, metrics flush, exit 0 (what a rolling restart
-    # wants). A SECOND signal means "now": exit immediately.
+    # wants). A SECOND signal means "now": close the open per-request
+    # trace tracks (reason 'killed' — the post-mortem trace must be
+    # honest about what was in flight) and exit immediately.
     import signal
 
     shutdown = {"flag": False}
@@ -238,6 +339,10 @@ def main(checkpoint_path, max_slots, max_queue, max_len, quantize_int8,
     def _request_drain(signum, frame):
         if shutdown["flag"]:
             print(f"signal {signum} again: exiting now", file=sys.stderr)
+            try:
+                sched.close_tracks("killed")
+            except Exception:
+                pass  # a torn trace line beats a hung exit
             sys.stderr.flush()
             os._exit(1)
         shutdown["flag"] = True
@@ -247,23 +352,31 @@ def main(checkpoint_path, max_slots, max_queue, max_len, quantize_int8,
             file=sys.stderr,
         )
 
+    def _request_reload(signum, frame):
+        reload_req["flag"] = True  # handler-minimal; tick() does the work
+
     old_term = signal.signal(signal.SIGTERM, _request_drain)
     old_int = signal.signal(signal.SIGINT, _request_drain)
+    old_hup = signal.signal(signal.SIGHUP, _request_reload)
     try:
         if socket_path:
             _serve_socket(sched, defaults, socket_path, publish,
-                          metrics_every, shutdown)
+                          metrics_every, shutdown, tick=tick)
         else:
             _serve_stdio(sched, defaults, publish, metrics_every,
-                         shutdown)
+                         shutdown, tick=tick, starts0=starts0,
+                         preamble=replayed_lines)
     finally:
         signal.signal(signal.SIGTERM, old_term)
         signal.signal(signal.SIGINT, old_int)
+        signal.signal(signal.SIGHUP, old_hup)
         publish()
         if prom_srv is not None:
             prom_srv.shutdown()
         telemetry.configure()  # detach before the sink closes
         tracker.finish()
+        if journal is not None:
+            journal.close()
 
 
 def _submit_line(sched, line, defaults):
@@ -303,41 +416,63 @@ def _shed_lines(sched, starts, owners=None):
     return out
 
 
-def _serve_stdio(sched, defaults, publish, metrics_every, shutdown):
+def _serve_stdio(sched, defaults, publish, metrics_every, shutdown,
+                 tick=None, starts0=None, preamble=None):
     """stdin-JSONL transport: poll stdin between decode steps so new
     requests join mid-flight (continuous batching, not read-all-then-
     drain); EOF stops intake and the loop drains what remains. A drain
     signal (see main) also stops intake, but sheds the QUEUE — only
-    in-flight slots run to completion."""
-    starts = {}
+    in-flight slots run to completion. ``tick`` runs once per loop
+    iteration (reload staging/commit); ``starts0``/``preamble`` carry
+    replayed-request state from --replay."""
+    starts = dict(starts0 or {})
     out = sys.stdout
     eof = False
     drained = False
     steps = 0
+    buf = ""  # bytes off the pipe that don't yet end in a newline
 
     def emit(lines):
         for ln in lines:
             out.write(ln + "\n")
         out.flush()
 
+    emit(list(preamble or []))
     while (not eof and not shutdown["flag"]) or sched.has_work:
+        if tick is not None:
+            tick()
         if shutdown["flag"] and not drained:
             drained = True
             sched.drain_queue()
         # take every line already waiting; bounded idle wait (not a full
-        # block) so a drain signal interrupts within one tick
+        # block) so a drain signal interrupts within one tick. Reads the
+        # raw fd into an explicit line buffer: select()+readline() loses
+        # lines — readline pulls everything waiting on the pipe into the
+        # TextIOWrapper buffer, returns ONE line, and select never
+        # reports the rest (they're no longer on the fd), so a client
+        # that writes a batch of requests and keeps the pipe open would
+        # see all but the first stall until its next write or EOF.
         while not eof and not shutdown["flag"]:
-            timeout = 0.2 if not sched.has_work else 0.0
-            try:
-                ready, _, _ = select.select([sys.stdin], [], [], timeout)
-            except OSError:
-                break
-            if not ready:
-                break
-            line = sys.stdin.readline()
-            if not line:
-                eof = True
-                break
+            nl = buf.find("\n")
+            if nl < 0:
+                timeout = 0.2 if not sched.has_work else 0.0
+                try:
+                    ready, _, _ = select.select([sys.stdin], [], [], timeout)
+                except OSError:
+                    break
+                if not ready:
+                    break
+                data = os.read(sys.stdin.fileno(), 65536)
+                if not data:
+                    eof = True
+                    # a final unterminated line still gets an answer (a
+                    # torn write parses as a rejection, not silence)
+                    line, buf = buf, ""
+                else:
+                    buf += data.decode("utf-8", errors="replace")
+                    continue
+            else:
+                line, buf = buf[:nl], buf[nl + 1:]
             if not line.strip():
                 continue
             rej, req = _submit_line(sched, line, defaults)
@@ -357,7 +492,7 @@ def _serve_stdio(sched, defaults, publish, metrics_every, shutdown):
 
 
 def _serve_socket(sched, defaults, socket_path, publish, metrics_every,
-                  shutdown):
+                  shutdown, tick=None):
     """Unix-socket transport: one select loop over {listener, clients,
     engine}; request ids are namespaced per connection internally so two
     clients may both call their request "1". On drain the listener
@@ -393,6 +528,8 @@ def _serve_socket(sched, defaults, socket_path, publish, metrics_every,
     drained = False
     try:
         while True:
+            if tick is not None:
+                tick()
             if shutdown["flag"]:
                 if not drained:
                     drained = True

@@ -24,10 +24,30 @@ retry-site label (resilience/retry.py labels its attempts). Specs:
                    the ``train/loss`` site in cli/train.py;
   * ``nan@N``    — like ``spike@N`` but returns NaN.
 
-Injection is wired in two places so no production code needs test-only
-seams: the telemetry span entry hook (installed by ``install_from_env``)
-and the per-attempt hook inside ``retry_call``. With ``PROGEN_CHAOS``
-unset everything here is a dict-lookup no-op.
+Injection is wired in three places so no production code needs
+test-only seams: the telemetry span entry hook (installed by
+``install_from_env``), the per-attempt hook inside ``retry_call``, and
+direct ``maybe_inject`` call sites on span-free hot paths. With
+``PROGEN_CHAOS`` unset everything here is a dict-lookup no-op.
+
+Serving targets (the serve kill-matrix, tests/test_serve_kill_matrix):
+
+  * ``serve/prefill``        — span entry when a request is admitted
+                               (kill here = die mid-prefill);
+  * ``serve/decode``         — called by the scheduler once per decode
+                               step, before the engine advances
+                               (``kill@N`` = die after N-1 full steps);
+  * ``serve/reload``         — background checkpoint load of a hot
+                               weight reload (kill = die mid-load,
+                               current weights were still serving);
+  * ``serve/reload_commit``  — the between-steps param swap (kill =
+                               die at the commit point; the swap is a
+                               host-side rebind, so it either fully
+                               applied or never happened).
+
+An unknown target (typo'd span name, renamed site) warns ONCE at
+install instead of silently never firing — a chaos rehearsal whose
+faults never land proves nothing.
 """
 
 from __future__ import annotations
@@ -35,10 +55,31 @@ from __future__ import annotations
 import os
 import random
 import signal
+import warnings
 from dataclasses import dataclass
 from typing import Dict, Optional
 
 from progen_tpu.resilience.retry import TransientError
+
+# every injectable site: span names + retry-site labels + perturb sites
+# + direct maybe_inject call sites. Kept in lockstep with the code (the
+# unknown-target warning below is what notices drift).
+KNOWN_TARGETS = frozenset({
+    # spans
+    "ckpt/finalize", "ckpt/restore", "ckpt/restore_params", "ckpt/save",
+    "serve/prefill", "serve/reload", "serve/reload_commit",
+    "train/ckpt", "train/compile", "train/eval", "train/rollback",
+    "train/sample",
+    # retry-site labels (resilience/retry.py)
+    "ckpt/io/meta_read", "ckpt/io/meta_write", "ckpt/io/restore",
+    "ckpt/io/save", "data/glob", "data/read",
+    # perturb sites
+    "train/loss",
+    # direct maybe_inject sites
+    "serve/decode",
+})
+
+_WARNED_UNKNOWN: set = set()
 
 
 class ChaosError(TransientError):
@@ -155,10 +196,27 @@ class ChaosInjector:
 _INJECTOR: Optional[ChaosInjector] = None
 
 
+def _warn_unknown_targets(rules: Dict[str, _Rule]) -> None:
+    """Once per unknown target per process: a rule aimed at a
+    nonexistent site never fires, and 'survived chaos' must not be
+    claimable when the chaos never happened."""
+    for target in rules:
+        if target in KNOWN_TARGETS or target in _WARNED_UNKNOWN:
+            continue
+        _WARNED_UNKNOWN.add(target)
+        warnings.warn(
+            f"PROGEN_CHAOS target {target!r} matches no known injection "
+            f"site (span name, retry label, or perturb site) — this "
+            f"rule will never fire",
+            stacklevel=3,
+        )
+
+
 def install(spec: str, seed: int = 0) -> ChaosInjector:
     """Install an injector and hook it into telemetry span entry."""
     global _INJECTOR
     _INJECTOR = ChaosInjector(spec, seed)
+    _warn_unknown_targets(_INJECTOR.rules)
     from progen_tpu.telemetry import spans
 
     if maybe_inject not in spans.SPAN_ENTRY_HOOKS:

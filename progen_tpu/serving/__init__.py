@@ -8,10 +8,21 @@ engine.py for the design. Typical use:
     ok, reason = sched.submit(Request(id="r0", prime=toks, length=128))
     while sched.has_work:
         events, completions = sched.step()
+
+Zero-downtime extras (journal.py / reload.py): give the scheduler a
+``RequestJournal`` and accepted work survives a kill (``replay_into``
+resumes it bit-identically); give the serve loop a ``WeightReloader``
+and checkpoints hot-swap between decode steps without recompiling.
 """
 
-from progen_tpu.serving.engine import ServeEngine, SlotBatch
+from progen_tpu.serving.engine import PreparedParams, ServeEngine, SlotBatch
+from progen_tpu.serving.journal import (
+    RequestJournal,
+    replay_into,
+    replay_requests,
+)
 from progen_tpu.serving.metrics import ServingMetrics
+from progen_tpu.serving.reload import WeightReloader
 from progen_tpu.serving.scheduler import (
     REJECT_DEADLINE,
     REJECT_DRAINING,
@@ -25,11 +36,16 @@ from progen_tpu.serving.scheduler import (
 __all__ = [
     "ServeEngine",
     "SlotBatch",
+    "PreparedParams",
     "ServingMetrics",
     "Scheduler",
     "Request",
     "TokenEvent",
     "Completion",
+    "RequestJournal",
+    "WeightReloader",
+    "replay_into",
+    "replay_requests",
     "REJECT_QUEUE_FULL",
     "REJECT_DEADLINE",
     "REJECT_DRAINING",

@@ -53,6 +53,17 @@ from progen_tpu.telemetry.spans import span as _span
 logger = logging.getLogger(__name__)
 
 
+class PreparedParams(NamedTuple):
+    """A checkpoint transformed and verified for hot swap by
+    ``ServeEngine.prepare_params`` (background-thread safe), waiting for
+    ``commit_params`` (loop thread, between decode steps)."""
+
+    params: dict
+    q_params: Optional[dict]
+    q_scales: Optional[dict]
+    quant_report: Optional[dict]
+
+
 class SlotBatch(NamedTuple):
     """Device-resident pooled state; every leaf's leading axis is
     ``max_slots``. A pytree, so it moves through jit/vmap whole."""
@@ -231,6 +242,22 @@ def _decode_step_q(model, q_params, scales, slots):
     return _decode_step_impl(model, params, slots)
 
 
+def _match_placement(new, live):
+    """Give a reloaded leaf the SAME placement key as the live one. The
+    jit fastpath cache keys on (aval, sharding, committed): checkpoint
+    restore hands back arrays committed to an explicit device while
+    ``model.init`` params are uncommitted, and swapping one kind for the
+    other silently recompiles the decode step on its next call — the
+    exact thing a hot reload promises not to do."""
+    if getattr(live, "committed", False):
+        return jax.device_put(new, live.sharding)
+    if getattr(new, "committed", False):
+        # host round-trip is the only way to drop a committed placement;
+        # runs on the reload background thread, never the serve loop
+        return jnp.asarray(np.asarray(new))
+    return new
+
+
 class ServeEngine:
     """Fixed-pool continuous-batching engine bound to one (model, params,
     max_slots, max_len). Host-side it is just a free-list and two jitted
@@ -280,23 +307,27 @@ class ServeEngine:
             self._q_params, self._q_scales, leaves = quantize_tree(
                 self.params
             )
-            self.quant_report = self._calibrate(leaves)
+            self.quant_report = self._calibrate(
+                leaves, self.params, self._q_params, self._q_scales
+            )
 
-    def _calibrate(self, leaves: list) -> dict:
+    def _calibrate(self, leaves: list, params, q_params, q_scales) -> dict:
         """The logged accuracy contract of the int8 path: per-leaf weight
         max-abs-error from quantize_tree plus the worst logits
         max-abs-error of the dequantized weights vs the full-precision
         path over a fixed calibration prompt through a fresh cache (the
-        exact op sequence decode runs)."""
+        exact op sequence decode runs). Takes the tree being calibrated
+        explicitly so a hot reload can calibrate candidate weights while
+        the live ones keep serving."""
         deq = dequantize_tree(
-            self._q_params, self._q_scales, self.model.config.compute_dtype
+            q_params, q_scales, self.model.config.compute_dtype
         )
         cache_a = cache_b = self.fresh_cache
         worst = 0.0
         for tok in (1, 7, 23, 4):  # fixed calibration prompt
             t = jnp.full((1, 1), tok, jnp.int32)
             la, mut_a = self.model.apply(
-                {"params": self.params, "cache": cache_a}, t,
+                {"params": params, "cache": cache_a}, t,
                 mutable=["cache"],
             )
             cache_a = mut_a["cache"]
@@ -324,6 +355,62 @@ class ServeEngine:
             {k: v for k, v in report.items() if k != "leaves"},
         )
         return report
+
+    # ----- hot weight reload ---------------------------------------------
+
+    def prepare_params(self, raw_params) -> PreparedParams:
+        """Background half of a hot swap: bring a freshly restored param
+        tree into this engine's decode layout and verify it is
+        hot-swappable — identical treedef and per-leaf shape/dtype vs
+        the live tree. Same shapes mean the two compiled programs
+        (prefill, decode step) are reused verbatim, which is the whole
+        zero-downtime contract; anything else raises ValueError and
+        needs a restart, not a reload. Leaf placement is matched to the
+        live tree (see ``_match_placement``) so the swap cannot change
+        the jit cache key. Re-runs int8 quantization + calibration when
+        the engine serves int8. Touches NO engine
+        state (safe off-thread while decode_step runs); the loop thread
+        applies the result with ``commit_params`` between steps."""
+        from progen_tpu.models.progen import unstack_params
+
+        params = unstack_params(raw_params, self.model.config)
+        ref = jax.tree_util.tree_flatten_with_path(self.params)
+        new = jax.tree_util.tree_flatten_with_path(params)
+        if ref[1] != new[1]:
+            raise ValueError(
+                "incompatible checkpoint: param tree structure differs "
+                "from the live tree (different model architecture?) — "
+                "hot reload needs a restart"
+            )
+        for (path, live), (_, cand) in zip(ref[0], new[0]):
+            if live.shape != cand.shape or live.dtype != cand.dtype:
+                raise ValueError(
+                    f"incompatible checkpoint: param "
+                    f"{jax.tree_util.keystr(path)} is "
+                    f"{cand.shape}/{cand.dtype}, live tree has "
+                    f"{live.shape}/{live.dtype} — hot reload needs a "
+                    f"restart"
+                )
+        params = jax.tree.map(_match_placement, params, self.params)
+        q_params = q_scales = report = None
+        if self.quantize_int8:
+            q_params, q_scales, leaves = quantize_tree(params)
+            report = self._calibrate(leaves, params, q_params, q_scales)
+        return PreparedParams(params, q_params, q_scales, report)
+
+    def commit_params(self, prepared: PreparedParams) -> None:
+        """Foreground half: rebind the served weights. The jitted
+        programs take params as a per-call operand, so between two
+        ``decode_step`` calls this is an atomic host-side swap — the
+        next step reads the new tree with zero recompiles (shape/dtype
+        equality enforced by ``prepare_params``). In-flight requests
+        continue on their existing KV caches; only future matmuls see
+        the new weights."""
+        self.params = prepared.params
+        if self.quantize_int8:
+            self._q_params = prepared.q_params
+            self._q_scales = prepared.q_scales
+            self.quant_report = prepared.quant_report
 
     # ----- slot lifecycle -------------------------------------------------
 

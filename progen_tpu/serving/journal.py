@@ -1,0 +1,256 @@
+"""Request replay journal: crash-safe accounting of accepted work.
+
+MegaScale's (NSDI '24) framing of fault tolerance is that the SLO is
+accepted work, not process uptime — and the serve loop used to fail it
+completely: a restart (preemption, chaos ``kill@N``, OOM) lost every
+queued and in-flight request. This journal closes that gap with three
+append-only record kinds in ``journal.jsonl``:
+
+    {"ev": "journal", "op": "accept", "req": ..., "prime": [...],
+     "length": ..., "key": [k0, k1], ...}          # full resume state
+    {"ev": "journal", "op": "token", "req": ..., "index": i, "token": t}
+    {"ev": "journal", "op": "done", "req": ..., "status": "completed"}
+
+Write discipline is the JsonlTracker contract: one ``write+flush`` per
+line under a lock, so a SIGKILL tears at most the final line — which
+``iter_jsonl`` skips (and counts) on read. Ordering carries the no-
+duplicate guarantee: the scheduler journals a token BEFORE the
+front-end emits it to a client, so any token a client ever saw is in
+the journal, and replay never re-emits a journaled index.
+
+Replay (``replay_requests`` / ``replay_into``) reconstructs every
+accepted request with no ``done`` record and resumes it by
+re-prefilling prompt + already-emitted tokens. Because the per-slot
+sampler splits its PRNG key exactly once per emitted token
+(``gumbel_step_dynamic``), fast-forwarding the journaled key by
+``n_emitted`` splits makes the resumed stream bit-identical to the
+uninterrupted one — the same ``sample_fast`` parity contract the
+engine itself is pinned to. Resumed requests are re-journaled as fresh
+accepts (compound prime, advanced key), so replay composes: a second
+crash replays from the second accept without revisiting the first.
+
+The ``op`` grammar and the raw-record privilege live HERE (linted by
+PGL006): any other module wanting journal records goes through
+RequestJournal, not hand-rolled dicts.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from progen_tpu.serving.scheduler import Request
+from progen_tpu.telemetry.spans import get_telemetry
+from progen_tpu.telemetry.trace import LineDrops, iter_jsonl
+
+STATUS_COMPLETED = "completed"
+
+
+class RequestJournal:
+    """Append-only journal of request acceptance, emitted-token
+    watermarks, and completion. One instance per serve process; safe to
+    call from the loop thread and signal handlers (per-line critical
+    section, reentrant lock)."""
+
+    def __init__(self, path):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._f = self.path.open("a")
+        self._lock = threading.RLock()
+
+    def emit(self, record: dict) -> None:
+        """One journal line, flushed before return — after ``accept``
+        returns, the request survives any kill; after ``token`` returns,
+        the token may be shown to a client."""
+        with self._lock:
+            if self._f.closed:
+                return
+            self._f.write(json.dumps(record) + "\n")
+            self._f.flush()
+
+    def accept(self, req: Request) -> None:
+        """Journal everything needed to re-create ``req`` from nothing.
+        The PRNG key is resolved NOW (explicit key, else seed-derived) so
+        replay does not depend on how the key was originally specified."""
+        import jax
+
+        key = req.key if req.key is not None else jax.random.PRNGKey(req.seed)
+        self.emit({
+            "ev": "journal", "op": "accept", "ts": time.time(),
+            "req": str(req.id),
+            "prime": [int(t) for t in np.asarray(req.prime).reshape(-1)],
+            "length": int(req.length),
+            "top_k": None if req.top_k is None else int(req.top_k),
+            "add_bos": bool(req.add_bos),
+            "temperature": float(req.temperature),
+            "top_p": None if req.top_p is None else float(req.top_p),
+            "key": [int(k) for k in np.asarray(key).reshape(-1)],
+            "deadline_s": req.deadline_s,
+        })
+
+    def token(self, request_id: str, index: int, token: int) -> None:
+        self.emit({
+            "ev": "journal", "op": "token", "ts": time.time(),
+            "req": str(request_id), "index": int(index),
+            "token": int(token),
+        })
+
+    def done(self, request_id: str, status: str,
+             n_generated: int = 0) -> None:
+        """Terminal record: ``completed``, or a shed reason
+        (``deadline_exceeded``/``draining``) — either way the request is
+        settled with its client and must never be replayed."""
+        self.emit({
+            "ev": "journal", "op": "done", "ts": time.time(),
+            "req": str(request_id), "status": str(status),
+            "n_generated": int(n_generated),
+        })
+
+    def close(self) -> None:
+        with self._lock:
+            self._f.close()
+
+
+def _advance_key(key, n: int):
+    """Fast-forward a PRNG key past ``n`` emitted tokens: the dynamic
+    sampler does ``key, sub = jax.random.split(key)`` once per draw, so
+    n keep-the-first splits land exactly where the dead process was."""
+    import jax
+
+    for _ in range(n):
+        key = jax.random.split(key)[0]
+    return key
+
+
+def _read_state(path, drops: Optional[LineDrops] = None) -> dict:
+    """Fold the journal into per-request state. Re-accepts (a replayed
+    run re-journals resumed requests) overwrite the resume parameters;
+    token watermarks accumulate by index across accepts — the indices of
+    successive rounds never overlap because each re-accept folds prior
+    tokens into its prime."""
+    state: dict = {}
+    for rec in iter_jsonl(path, drops):
+        if rec.get("ev") != "journal":
+            continue
+        rid = rec.get("req")
+        entry = state.setdefault(
+            rid, {"accept": None, "tokens": {}, "done": None}
+        )
+        op = rec.get("op")
+        if op == "accept":
+            entry["accept"] = rec
+        elif op == "token":
+            entry["tokens"][int(rec["index"])] = int(rec["token"])
+        elif op == "done":
+            entry["done"] = rec
+    return state
+
+
+def replay_requests(
+    path, drops: Optional[LineDrops] = None
+) -> Tuple[List[Request], List[dict], int]:
+    """Reconstruct unfinished work from a journal.
+
+    Returns ``(pending, finished, n_done)``:
+      * ``pending`` — Requests ready to resubmit: prime = original
+        prime + every journaled token, key fast-forwarded by the number
+        of emitted tokens, same length/knobs — the resumed stream is
+        bit-identical to the uninterrupted one;
+      * ``finished`` — requests whose journaled stream already satisfies
+        the stop rule (hit length, or emitted the second zero) but died
+        before the ``done`` record: nothing to decode, the caller
+        settles them with ``emitted`` as the generated suffix;
+      * ``n_done`` — requests with a terminal record, skipped entirely
+        (the dedup half of the zero-duplicate guarantee).
+    """
+    import jax.numpy as jnp
+
+    pending: List[Request] = []
+    finished: List[dict] = []
+    n_done = 0
+    for rid, entry in _read_state(path, drops).items():
+        if entry["done"] is not None:
+            n_done += 1
+            continue
+        acc = entry["accept"]
+        if acc is None:
+            continue  # tokens without an accept: torn journal head
+        prime = [int(t) for t in acc["prime"]]
+        add_bos = bool(acc.get("add_bos", False))
+        start = len(prime) + (1 if add_bos else 0)
+        # contiguous emitted run from this accept's first write position
+        emitted: List[int] = []
+        while start + len(emitted) in entry["tokens"]:
+            emitted.append(entry["tokens"][start + len(emitted)])
+        length = int(acc["length"])
+        zeros = (
+            (1 if add_bos else 0)
+            + sum(1 for t in prime if t == 0)
+            + sum(1 for t in emitted if t == 0)
+        )
+        if start + len(emitted) >= length or zeros >= 2:
+            finished.append(
+                {"id": rid, "emitted": emitted, "accept": acc}
+            )
+            continue
+        key = _advance_key(
+            jnp.asarray(acc["key"], jnp.uint32), len(emitted)
+        )
+        pending.append(Request(
+            id=rid,
+            prime=np.asarray(prime + emitted, np.int32),
+            length=length,
+            top_k=acc.get("top_k"),
+            add_bos=add_bos,
+            temperature=float(acc.get("temperature", 1.0)),
+            top_p=acc.get("top_p"),
+            key=key,
+            # deadline intentionally dropped: it measured queue wait in
+            # the DEAD process; re-applying it here would shed the very
+            # requests replay exists to save
+            deadline_s=None,
+        ))
+    return pending, finished, n_done
+
+
+def replay_into(scheduler, path) -> dict:
+    """Resubmit a journal's unfinished work into a (fresh) scheduler.
+    Requests that already satisfied their stop rule are settled
+    directly: a ``done`` journal record is written so a second replay
+    skips them, and they are returned for the front-end to answer.
+    Returns ``{"resumed": [Request...], "finished": [{"id", "emitted"}],
+    "skipped_done": n, "rejected": [(id, reason)], "dropped_lines": n}``.
+    """
+    drops = LineDrops()
+    pending, finished, n_done = replay_requests(path, drops)
+    resumed: List[Request] = []
+    rejected: List[Tuple[str, str]] = []
+    for req in pending:
+        ok, reason = scheduler.submit(req)
+        if ok:
+            resumed.append(req)
+        else:
+            rejected.append((req.id, reason or "rejected"))
+    journal = getattr(scheduler, "journal", None)
+    if journal is not None:
+        for f in finished:
+            journal.done(f["id"], STATUS_COMPLETED, 0)
+    scheduler.metrics.inc("journal_replayed", len(resumed))
+    get_telemetry().emit({
+        "ev": "journal_replay", "ts": time.time(),
+        "resumed": len(resumed), "finished": len(finished),
+        "skipped_done": n_done, "rejected": len(rejected),
+        "dropped_lines": drops.count,
+    })
+    return {
+        "resumed": resumed,
+        "finished": finished,
+        "skipped_done": n_done,
+        "rejected": rejected,
+        "dropped_lines": drops.count,
+    }

@@ -1,0 +1,187 @@
+"""Hot weight reload: swap checkpoints under live traffic.
+
+The slot-pool engine passes its params into the two compiled programs
+as per-call operands, and Orca-style iteration-level scheduling means
+the loop sits at a clean barrier between any two ``decode_step``
+calls — so new weights of identical shape can be swapped in with zero
+recompiles and zero dropped requests. This module stages the expensive
+part off-thread and leaves only an attribute rebind on the loop:
+
+  * ``request_reload()`` — spawn a background load: restore the newest
+    checkpoint through the digest-manifest chain (a corrupt target is
+    quarantined as ``ckpt_N.corrupt`` by the walk and the fallback that
+    lands back on the currently served checkpoint is REJECTED, not
+    re-applied), then ``engine.prepare_params`` (layout transform,
+    tree/shape/dtype compatibility check, int8 re-quant + calibration);
+  * ``maybe_commit()`` — called by the serve loop between decode
+    steps: applies a staged result atomically, or does nothing;
+  * ``poll_watch()`` — optional checkpoint-dir watcher behind
+    ``--reload_watch``: kicks a reload when a new complete checkpoint
+    appears.
+
+Every outcome is observable: ``serve/reload`` / ``serve/reload_commit``
+spans bracket the work (chaos-injectable kill points for the serve
+kill-matrix), ``{"ev": "reload", "status": ...}`` instants record
+staged/committed/rejected in events.jsonl, and the serving metrics grow
+``reloads`` / ``reload_rejected`` counters plus a ``reload_duration_s``
+summary. The ``reload`` record grammar lives HERE (linted by PGL006).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from pathlib import Path
+from typing import Optional
+
+from progen_tpu.serving.engine import ServeEngine
+from progen_tpu.telemetry.spans import get_telemetry, span
+
+
+class WeightReloader:
+    """One per serve process. ``current`` is the name of the checkpoint
+    directory now serving (``ckpt_<stamp>``); reloads that resolve back
+    to it — including the digest walk falling back after quarantining a
+    corrupt newer one — are rejected as no-ops."""
+
+    def __init__(self, engine: ServeEngine, checkpoint_path, *,
+                 metrics=None, current: Optional[str] = None):
+        from progen_tpu.checkpoint import get_checkpoint_fns
+
+        self.engine = engine
+        self.checkpoint_path = str(checkpoint_path)
+        self._get_last = get_checkpoint_fns(self.checkpoint_path)[1]
+        self.metrics = metrics
+        self.current = current
+        self.last_error: Optional[str] = None
+        self._lock = threading.Lock()
+        self._staged: Optional[tuple] = None  # (name, prepared, load_s)
+        self._thread: Optional[threading.Thread] = None
+        self._watch_mark = 0.0
+        if metrics is not None:
+            # families exist (at zero) from construction so the
+            # Prometheus exposition is stable before the first reload
+            metrics.inc("reloads", 0)
+            metrics.inc("reload_rejected", 0)
+            metrics.declare_timing("reload_duration_s")
+
+    # ----- background load ------------------------------------------------
+
+    def request_reload(self) -> bool:
+        """Kick a background load of the newest verified checkpoint.
+        False when one is already in flight (SIGHUP storms coalesce)."""
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return False
+            self._thread = threading.Thread(
+                target=self._load, name="weight-reload", daemon=True
+            )
+            self._thread.start()
+            return True
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        """Test/shutdown seam: wait for an in-flight load to stage."""
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+
+    def _reject(self, reason: str) -> None:
+        self.last_error = reason
+        if self.metrics is not None:
+            self.metrics.inc("reload_rejected")
+        get_telemetry().emit({
+            "ev": "reload", "ts": time.time(), "status": "rejected",
+            "reason": reason,
+        })
+
+    def _load(self) -> None:
+        """Runs on the background thread. Current weights keep serving
+        no matter what happens here — nothing touches the engine until
+        ``maybe_commit`` on the loop thread."""
+        t0 = time.perf_counter()
+        try:
+            with span("serve/reload"):
+                pkg = self._get_last.restore_params()
+                if pkg is None:
+                    self._reject("no_checkpoint")
+                    return
+                name = Path(pkg.path).name if pkg.path else None
+                if name is not None and name == self.current:
+                    # the verify walk landed on what we already serve
+                    # (nothing newer, or the newer one was quarantined)
+                    self._reject("no_new_checkpoint")
+                    return
+                prepared = self.engine.prepare_params(pkg.state)
+        except Exception as e:  # incompat, I/O, injected chaos — reject
+            self._reject(f"{type(e).__name__}: {e}")
+            return
+        with self._lock:
+            self._staged = (name, prepared, time.perf_counter() - t0)
+        get_telemetry().emit({
+            "ev": "reload", "ts": time.time(), "status": "staged",
+            "ckpt": name,
+        })
+
+    # ----- loop-thread commit ----------------------------------------------
+
+    def maybe_commit(self) -> Optional[str]:
+        """Apply a staged reload, if any. The serve loop calls this
+        between decode steps — the only place a swap is atomic with
+        respect to in-flight tokens. Returns the committed checkpoint
+        name, or None."""
+        with self._lock:
+            staged, self._staged = self._staged, None
+        if staged is None:
+            return None
+        name, prepared, load_s = staged
+        t0 = time.perf_counter()
+        with span("serve/reload_commit",
+                  ckpt="" if name is None else str(name)):
+            self.engine.commit_params(prepared)
+            self.current = name
+        total = load_s + (time.perf_counter() - t0)
+        self.last_error = None
+        if self.metrics is not None:
+            self.metrics.inc("reloads")
+            self.metrics.observe("reload_duration_s", total)
+        get_telemetry().emit({
+            "ev": "reload", "ts": time.time(), "status": "committed",
+            "ckpt": name, "duration_s": round(total, 6),
+        })
+        return name
+
+    # ----- checkpoint-dir watcher -------------------------------------------
+
+    def poll_watch(self, interval_s: float = 2.0) -> bool:
+        """Throttled directory scan: when a complete checkpoint newer
+        than ``current`` exists and nothing is in flight or staged,
+        kick a reload. Returns True when one was kicked."""
+        now = time.monotonic()
+        if now - self._watch_mark < interval_s:
+            return False
+        self._watch_mark = now
+        newest = self._newest_complete()
+        if newest is None or newest == self.current:
+            return False
+        with self._lock:
+            busy = (
+                self._staged is not None
+                or (self._thread is not None and self._thread.is_alive())
+            )
+        if busy:
+            return False
+        return self.request_reload()
+
+    def _newest_complete(self) -> Optional[str]:
+        from progen_tpu.checkpoint import _CKPT_NAME_RE
+
+        root = Path(self.checkpoint_path)
+        try:
+            names = sorted(
+                p.name for p in root.iterdir()
+                if _CKPT_NAME_RE.fullmatch(p.name)
+                and (p / "meta.json").exists()
+            )
+        except OSError:
+            return None
+        return names[-1] if names else None

@@ -34,6 +34,7 @@ from typing import Callable, List, Optional, Tuple
 
 import numpy as np
 
+from progen_tpu.resilience.chaos import maybe_inject
 from progen_tpu.serving.engine import ServeEngine
 from progen_tpu.serving.metrics import ServingMetrics
 from progen_tpu.telemetry.spans import get_telemetry
@@ -106,12 +107,19 @@ class Scheduler:
 
     def __init__(self, engine: ServeEngine, *, max_queue: int = 64,
                  metrics: Optional[ServingMetrics] = None,
-                 clock: Callable[[], float] = time.perf_counter):
+                 clock: Callable[[], float] = time.perf_counter,
+                 journal=None):
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self.engine = engine
         self.max_queue = int(max_queue)
         self.metrics = metrics if metrics is not None else ServingMetrics()
+        # optional RequestJournal (serving/journal.py): accepted work is
+        # journaled durably before submit() acknowledges it, every token
+        # before step() returns it (i.e. before a client can see it),
+        # and every settlement (completion OR shed) — the ordering the
+        # replay-without-duplicates guarantee rests on
+        self.journal = journal
         self._clock = clock
         self._queue: deque[Tuple[Request, float]] = deque()
         self._active: dict[int, _Active] = {}
@@ -161,11 +169,32 @@ class Scheduler:
                      ts: Optional[float] = None) -> None:
         """Close an accepted-but-never-admitted request's track: the
         shed instant, then the still-open queued phase, then the
-        envelope."""
+        envelope. The shed is also a journal settlement — the client
+        was told 'rejected', so replay must never resurrect it."""
         ts = time.time() if ts is None else ts
         self._req_event("n", rid, reason, ts=ts)
         self._req_event("e", rid, "queued", ts=ts)
         self._req_event("e", rid, "request", ts=ts, reason=reason)
+        if self.journal is not None:
+            self.journal.done(rid, reason, 0)
+
+    def close_tracks(self, reason: str = "killed") -> None:
+        """Crash-path teardown (second-signal "exit now"): close every
+        open per-request async track so the post-mortem trace is honest
+        — a ``b`` without its ``e`` should mean the process DIED
+        mid-phase, not that it chose to exit. Deliberately NOT a journal
+        settlement: these requests were never answered, so replay must
+        pick them up."""
+        now = time.time()
+        for slot in sorted(self._active):
+            rid = self._active[slot].req.id
+            self._req_event("n", rid, reason, ts=now)
+            self._req_event("e", rid, "decode", ts=now)
+            self._req_event("e", rid, "request", ts=now, reason=reason)
+        for req, _ in self._queue:
+            self._req_event("n", req.id, reason, ts=now)
+            self._req_event("e", req.id, "queued", ts=now)
+            self._req_event("e", req.id, "request", ts=now, reason=reason)
 
     # ----- intake ---------------------------------------------------------
 
@@ -201,6 +230,10 @@ class Scheduler:
         self._req_event("b", req.id, "request", ts=now,
                         length=int(req.length))
         self._req_event("b", req.id, "queued", ts=now)
+        if self.journal is not None:
+            # durable before acknowledged: once the caller sees True,
+            # the request survives any kill via --replay
+            self.journal.accept(req)
         return True, None
 
     # ----- the loop -------------------------------------------------------
@@ -300,6 +333,11 @@ class Scheduler:
         self._admit()
         if not self._active:
             return [], []
+        # chaos site (PROGEN_CHAOS="serve/decode:kill@N"): decode has no
+        # span of its own (per-token span records would swamp the
+        # trace), so the injector is called directly, like the
+        # retry-site labels in resilience/retry.py
+        maybe_inject("serve/decode")
         t0 = self._clock()
         sampled, was_live, finished = self.engine.decode_step()
         t1 = self._clock()
@@ -334,6 +372,15 @@ class Scheduler:
             )
             if done:
                 completions.append(self._finish(slot, rec, now))
+        if self.journal is not None:
+            # watermarks are journaled BEFORE step() returns — a token a
+            # client ever saw is always in the journal, so replay can
+            # never emit a (request, index) twice
+            for ev in events:
+                self.journal.token(ev.request_id, ev.index, ev.token)
+            for c in completions:
+                self.journal.done(c.request_id, "completed",
+                                  c.n_generated)
         self.metrics.inc("decode_steps")
         self.metrics.inc("decode_tokens", n_live)
         self.metrics.add_time("decode_time_s", t1 - t0)

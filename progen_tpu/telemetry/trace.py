@@ -57,7 +57,7 @@ _REQ_STRUCTURAL = _STRUCTURAL | {"ph", "name", "req"}
 INSTANT_EVENTS = (
     "retry", "anomaly", "anomaly_rollback", "stall", "stall_escalation",
     "ckpt_quarantine", "ckpt_commit_failed", "chaos", "goodput",
-    "clock_beacon", "request_rejected",
+    "clock_beacon", "request_rejected", "reload", "journal_replay",
 )
 
 # metrics.jsonl columns that get their own counter track

@@ -79,8 +79,13 @@ class JsonlTracker(NoopTracker):
         # the watchdog thread, async-checkpoint paths, and retry hooks
         # all emit through log_event concurrently with the train loop's
         # log(); the lock makes every write+flush one critical section
-        # so JSONL lines can never tear or interleave
-        self._lock = threading.Lock()
+        # so JSONL lines can never tear or interleave. REENTRANT: the
+        # serve CLI's second-signal handler logs through this same
+        # tracker and a signal can land while the main thread holds the
+        # lock mid-write — a plain Lock would deadlock the exit path. A
+        # reentrant write can interleave into the interrupted line, but
+        # iter_jsonl skips (and counts) torn lines by contract.
+        self._lock = threading.RLock()
 
     def log(self, metrics: dict, step: Optional[int] = None) -> None:
         rec = {"_time": time.time(), **metrics}

@@ -27,3 +27,16 @@ def clean_beacon(emit):
 def clean_serving_metrics(reg):
     reg.observe("itl_s", 0.01)
     reg.set_gauge("slot_occupancy", 2)
+
+
+def clean_reload_metrics(reg):
+    # reload/journal METRICS are fine anywhere — only raw records are
+    # restricted to their owning modules
+    reg.inc("reloads")
+    reg.inc("journal_replayed")
+    reg.observe("reload_duration_s", 1.5)
+
+
+def clean_replay_instant(emit):
+    # journal_replay is a plain instant, not a journal record
+    emit({"ev": "journal_replay", "ts": 1.0, "resumed": 3})

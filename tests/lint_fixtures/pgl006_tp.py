@@ -1,4 +1,4 @@
-"""PGL006 true positives: telemetry hygiene. Expected findings: 6."""
+"""PGL006 true positives: telemetry hygiene. Expected findings: 11."""
 
 
 def unbounded_span(telemetry, name):
@@ -22,3 +22,20 @@ def raw_req_record(emit):
 def bad_async_ph(emit):
     # TP x2: req record outside the scheduler AND a 'ph' outside b/n/e
     emit({"ev": "req", "ph": "X", "name": "queued", "req": "r1"})
+
+
+def raw_journal_record(emit):
+    # TP: journal record outside serving/journal.py
+    emit({"ev": "journal", "op": "accept", "req": "r1"})
+
+
+def bad_journal_op(emit):
+    # TP x2: outside serving/journal.py AND an op outside the
+    # accept/token/done replay alphabet
+    emit({"ev": "journal", "op": "acknowledge", "req": "r1"})
+
+
+def bad_reload_status(emit):
+    # TP x2: reload record outside serving/reload.py AND a status the
+    # zero-downtime smoke can't classify
+    emit({"ev": "reload", "status": "half_done"})

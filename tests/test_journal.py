@@ -1,0 +1,333 @@
+"""Request replay journal: crash-safe recovery of accepted work.
+
+The contract under test (serving/journal.py): every request the
+scheduler ACCEPTED either completes in the original process or is
+reconstructed bit-identically by replay — and work a client already saw
+(journaled tokens, settled requests) is never re-emitted. The parity
+half rides on the same ``sample_fast`` pin as test_serving.py: a
+resumed stream must equal the uninterrupted one token-for-token.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from progen_tpu.config import ProGenConfig
+from progen_tpu.models.progen import ProGen
+from progen_tpu.sampling import sample_fast
+from progen_tpu.serving import (
+    Request,
+    RequestJournal,
+    Scheduler,
+    ServeEngine,
+    replay_into,
+    replay_requests,
+)
+from progen_tpu.serving.journal import _advance_key
+from progen_tpu.telemetry.trace import LineDrops
+
+TINY = ProGenConfig(
+    num_tokens=32,
+    dim=32,
+    seq_len=32,
+    depth=2,
+    window_size=8,
+    global_mlp_depth=1,
+    heads=2,
+    dim_head=16,
+    ff_mult=2,
+    dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = ProGen(TINY)
+    tokens = jnp.zeros((1, TINY.seq_len), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), tokens)
+    from flax.core import meta
+
+    return model, meta.unbox(variables)["params"]
+
+
+def _fresh(model, params, journal_path):
+    engine = ServeEngine(model, params, max_slots=2, max_len=24)
+    sched = Scheduler(engine, journal=RequestJournal(journal_path))
+    return engine, sched
+
+
+def _reference(model, params, req):
+    key = req.key if req.key is not None else jax.random.PRNGKey(req.seed)
+    return np.asarray(
+        sample_fast(
+            key, model, params, jnp.asarray(req.prime, jnp.int32),
+            req.length, top_k=req.top_k, add_bos=req.add_bos,
+            temperature=req.temperature, top_p=req.top_p,
+        )
+    )
+
+
+class TestJournalRecords:
+    def test_accept_round_trip(self, tmp_path):
+        """An accept record carries everything needed to re-create the
+        request from nothing — including the key resolved from a seed."""
+        path = tmp_path / "journal.jsonl"
+        j = RequestJournal(path)
+        req = Request(
+            id="a", prime=np.asarray([3, 5, 9], np.int32), length=12,
+            top_k=7, add_bos=True, temperature=0.8, top_p=0.9, seed=5,
+        )
+        j.accept(req)
+        j.close()
+
+        pending, finished, n_done = replay_requests(path)
+        assert finished == [] and n_done == 0
+        (r,) = pending
+        assert r.id == "a"
+        np.testing.assert_array_equal(r.prime, req.prime)
+        assert (r.length, r.top_k, r.add_bos) == (12, 7, True)
+        assert (r.temperature, r.top_p) == (0.8, 0.9)
+        np.testing.assert_array_equal(
+            np.asarray(r.key), np.asarray(jax.random.PRNGKey(5))
+        )
+        # queue-TTL deadlines measured wait in the dead process; replay
+        # must not re-apply them
+        assert r.deadline_s is None
+
+    def test_token_watermarks_fold_into_resume_state(self, tmp_path):
+        """Journaled tokens extend the prime and fast-forward the key by
+        exactly one split per emitted token."""
+        path = tmp_path / "journal.jsonl"
+        j = RequestJournal(path)
+        key0 = jax.random.PRNGKey(11)
+        req = Request(
+            id="a", prime=np.asarray([3, 5], np.int32), length=10,
+            add_bos=False, key=key0,
+        )
+        j.accept(req)
+        for i, t in enumerate([11, 12, 13]):
+            j.token("a", 2 + i, t)  # first generated index == len(prime)
+        j.close()
+
+        (r,), finished, _ = replay_requests(path)
+        assert finished == []
+        np.testing.assert_array_equal(
+            r.prime, np.asarray([3, 5, 11, 12, 13], np.int32)
+        )
+        want = jax.random.PRNGKey(11)
+        want = jax.random.split(want)[0]
+        want = jax.random.split(want)[0]
+        want = jax.random.split(want)[0]
+        want = np.asarray(want)
+        np.testing.assert_array_equal(np.asarray(r.key), want)
+        np.testing.assert_array_equal(
+            np.asarray(_advance_key(jax.random.PRNGKey(11), 3)), want
+        )
+
+    def test_torn_tail_and_garbage_skipped(self, tmp_path):
+        """A SIGKILL tears at most the final line; replay must survive it
+        (and stray garbage) while counting what it skipped."""
+        path = tmp_path / "journal.jsonl"
+        j = RequestJournal(path)
+        j.accept(Request(id="a", prime=np.asarray([3], np.int32), length=8))
+        j.token("a", 1, 9)
+        j.close()
+        with path.open("a") as f:
+            f.write("not json at all\n")
+            f.write('{"ev": "journal", "op": "token", "req": "a", "ind')
+
+        drops = LineDrops()
+        (r,), _, _ = replay_requests(path, drops)
+        assert drops.count == 2
+        np.testing.assert_array_equal(r.prime, np.asarray([3, 9], np.int32))
+
+    def test_done_skips_replay(self, tmp_path):
+        path = tmp_path / "journal.jsonl"
+        j = RequestJournal(path)
+        j.accept(Request(id="a", prime=np.asarray([3], np.int32), length=8))
+        j.done("a", "completed", 6)
+        j.close()
+        pending, finished, n_done = replay_requests(path)
+        assert pending == [] and finished == [] and n_done == 1
+
+    def test_stream_that_hit_its_stop_rule_is_finished(self, tmp_path):
+        """Died after the last token but before the done record: nothing
+        to decode — replay settles it instead of resubmitting."""
+        path = tmp_path / "journal.jsonl"
+        j = RequestJournal(path)
+        j.accept(Request(
+            id="full", prime=np.asarray([3, 5], np.int32), length=6,
+            add_bos=False,
+        ))
+        for i, t in enumerate([7, 8, 9, 1]):
+            j.token("full", 2 + i, t)  # start + 4 == length
+        # second-zero stop: BOS pads a zero, the emitted 0 is the second
+        j.accept(Request(
+            id="eos", prime=np.asarray([3], np.int32), length=20,
+            add_bos=True,
+        ))
+        j.token("eos", 2, 5)
+        j.token("eos", 3, 0)
+        j.close()
+
+        pending, finished, n_done = replay_requests(path)
+        assert pending == [] and n_done == 0
+        by_id = {f["id"]: f for f in finished}
+        assert by_id["full"]["emitted"] == [7, 8, 9, 1]
+        assert by_id["eos"]["emitted"] == [5, 0]
+
+    def test_emit_after_close_is_a_noop(self, tmp_path):
+        path = tmp_path / "journal.jsonl"
+        j = RequestJournal(path)
+        j.done("a", "completed")
+        j.close()
+        j.token("a", 2, 5)  # late writer during teardown: dropped
+        assert path.read_text().count("\n") == 1
+
+
+class TestCrashResume:
+    def test_kill_mid_decode_resumes_bit_identically(
+        self, tmp_path, model_and_params
+    ):
+        """The tentpole invariant, in-process: run a journaled scheduler
+        for a few steps, abandon it (the in-process stand-in for
+        SIGKILL), replay into a FRESH engine+scheduler, and require
+        (a) zero lost accepted requests, (b) zero duplicate
+        (request, index) emissions, (c) every emitted token — before and
+        after the crash — equal to the uninterrupted ``sample_fast``
+        stream, (d) completions bit-equal to the reference buffer."""
+        model, params = model_and_params
+        path = tmp_path / "journal.jsonl"
+        rng = np.random.RandomState(3)
+        knob_grid = [
+            {},
+            {"temperature": 0.7, "add_bos": True},
+            {"top_p": 0.9},
+            {"top_k": 5, "temperature": 1.2},
+        ]
+        reqs = []
+        for i in range(4):
+            prime = rng.randint(1, TINY.num_tokens, size=rng.randint(1, 5))
+            reqs.append(Request(
+                id=f"r{i}", prime=prime.astype(np.int32),
+                length=int(rng.randint(len(prime) + 3, 22)),
+                key=jax.random.PRNGKey(500 + i), **knob_grid[i],
+            ))
+
+        _, sched1 = _fresh(model, params, path)
+        for req in reqs:
+            ok, reason = sched1.submit(req)
+            assert ok, reason
+        ev1, comp1 = [], []
+        for _ in range(5):
+            ev, comp = sched1.step()
+            ev1.extend(ev)
+            comp1.extend(comp)
+        assert ev1, "no tokens journaled before the crash"
+        sched1.journal.close()  # the process is now 'dead'
+
+        eng2, sched2 = _fresh(model, params, path)
+        summary = replay_into(sched2, path)
+        ev2, comp2 = sched2.run_to_completion(max_steps=500)
+
+        done1 = {c.request_id for c in comp1}
+        resumed = {r.id for r in summary["resumed"]}
+        settled = {f["id"] for f in summary["finished"]}
+        # (a) every accepted request is accounted for exactly once
+        assert summary["rejected"] == []
+        assert done1 | resumed | settled == {r.id for r in reqs}
+        assert summary["skipped_done"] == len(done1)
+        by_id2 = {c.request_id: c for c in comp2}
+        assert set(by_id2) == resumed
+        # (b) no (request, index) emitted twice across the two lives
+        pairs = [(e.request_id, e.index) for e in ev1 + ev2]
+        assert len(set(pairs)) == len(pairs)
+        # (c) + (d) bit-parity with the uninterrupted stream
+        for req in reqs:
+            ref = _reference(model, params, req)
+            for e in ev1 + ev2:
+                if e.request_id == req.id:
+                    assert ref[e.index] == e.token, (req.id, e.index)
+            if req.id in by_id2:
+                np.testing.assert_array_equal(by_id2[req.id].tokens, ref)
+        assert (
+            sched2.metrics.counters["journal_replayed"]
+            == len(summary["resumed"])
+        )
+
+        # dedup composes: a third replay of the (now fully settled)
+        # journal resumes nothing and skips everything
+        sched3 = Scheduler(eng2, journal=RequestJournal(path))
+        again = replay_into(sched3, path)
+        assert again["resumed"] == [] and again["finished"] == []
+        assert again["skipped_done"] == len(reqs)
+
+    def test_shed_requests_are_settled_not_replayed(
+        self, tmp_path, model_and_params
+    ):
+        """Drained/expired requests were answered ('rejected: ...') —
+        replay must not resurrect them."""
+        model, params = model_and_params
+        path = tmp_path / "journal.jsonl"
+        _, sched = _fresh(model, params, path)
+        for i in range(3):
+            ok, _ = sched.submit(Request(
+                id=f"q{i}", prime=np.asarray([4 + i], np.int32), length=8,
+            ))
+            assert ok
+        assert sched.drain_queue() == 3
+        sched.journal.close()
+
+        pending, finished, n_done = replay_requests(path)
+        assert pending == [] and finished == [] and n_done == 3
+
+    def test_close_tracks_does_not_settle(
+        self, tmp_path, model_and_params
+    ):
+        """The second-signal 'exit now' path closes trace tracks but
+        journals nothing: killed requests were never answered, so they
+        MUST come back on replay."""
+        model, params = model_and_params
+        path = tmp_path / "journal.jsonl"
+        _, sched = _fresh(model, params, path)
+        for i in range(2):
+            ok, _ = sched.submit(Request(
+                id=f"k{i}", prime=np.asarray([4 + i], np.int32),
+                length=20, key=jax.random.PRNGKey(i),
+            ))
+            assert ok
+        sched.step()  # both admitted, one token each
+        sched.close_tracks("killed")
+        sched.journal.close()
+
+        pending, _, n_done = replay_requests(path)
+        assert n_done == 0
+        assert {r.id for r in pending} == {"k0", "k1"}
+        for r in pending:
+            assert len(r.prime) == 2  # original 1-token prime + 1 emitted
+
+    def test_replay_settles_finished_and_second_replay_skips(
+        self, tmp_path, model_and_params
+    ):
+        model, params = model_and_params
+        path = tmp_path / "journal.jsonl"
+        j = RequestJournal(path)
+        j.accept(Request(
+            id="full", prime=np.asarray([3, 5], np.int32), length=6,
+            add_bos=False,
+        ))
+        for i, t in enumerate([7, 8, 9, 1]):
+            j.token("full", 2 + i, t)
+        j.close()
+
+        _, sched = _fresh(model, params, path)
+        summary = replay_into(sched, path)
+        assert [f["id"] for f in summary["finished"]] == ["full"]
+        assert summary["resumed"] == []
+        assert not sched.has_work  # settled, not resubmitted
+
+        again = replay_into(sched, path)  # the done record was journaled
+        assert again["finished"] == [] and again["skipped_done"] == 1
