@@ -22,11 +22,17 @@ dashboard query then matches nothing. Three checks:
     PR-7 names (``clock_beacon``, ``itl_s``, ``slots`` /
     ``slot_occupancy``) like any other;
   * raw ``"ev": "req"`` async-lifecycle records must not be emitted
-    outside ``serving/scheduler.py`` — the scheduler owns the
-    queued/prefill/decode phase grammar and the every-``b``-gets-its-
-    ``e`` exception-safety burden (same reasoning as B/E ↔ spans.py),
-    and a literal ``"ph"`` in a req record must be one of
-    ``"b"``/``"n"``/``"e"`` (the async trace-event alphabet);
+    outside ``serving/scheduler.py`` or ``serving/router.py`` — those
+    two own the queued/prefill/decode (and routed/dispatched) phase
+    grammar and the every-``b``-gets-its-``e`` exception-safety burden
+    (same reasoning as B/E ↔ spans.py), and a literal ``"ph"`` in a
+    req record must be one of ``"b"``/``"n"``/``"e"`` (the async
+    trace-event alphabet);
+  * raw ``"ev": "route"`` records must not be emitted outside
+    ``serving/router.py``, and a literal ``"status"`` must be one of
+    ``dispatched``/``handoff``/``shed``/``replica_down`` — the router
+    section of ``summarize`` (and the failover smoke in CI) keys on
+    exactly this alphabet;
   * raw ``"ev": "journal"`` records must not be emitted outside
     ``serving/journal.py`` — the replay journal's ``op`` grammar
     (``accept``/``token``/``done``) IS the crash-recovery contract
@@ -136,16 +142,37 @@ class TelemetryHygieneRule(Rule):
                     "guarantees the matching E even on exceptions",
                 )
             elif v.value == "req":
-                if not self._in_scheduler_module():
+                if not (
+                    self._in_scheduler_module()
+                    or self._in_module("serving/router.py")
+                ):
                     self.report(
                         v,
                         "raw async req record emitted outside "
-                        "serving/scheduler.py — the scheduler owns the "
-                        "request lifecycle grammar (every 'b' must get "
-                        "its 'e' on all exit paths); go through "
-                        "Scheduler, not hand-rolled records",
+                        "serving/scheduler.py or serving/router.py — "
+                        "they own the request lifecycle grammar (every "
+                        "'b' must get its 'e' on all exit paths); go "
+                        "through Scheduler/Router, not hand-rolled "
+                        "records",
                     )
                 self._check_req_ph(d)
+            elif v.value == "route":
+                if not self._in_module("serving/router.py"):
+                    self.report(
+                        v,
+                        "raw route record emitted outside "
+                        "serving/router.py — the routing-decision "
+                        "grammar is what summarize's router section and "
+                        "the CI failover smoke key on; go through "
+                        "Router, not hand-rolled records",
+                    )
+                self._check_literal_member(
+                    d, "status",
+                    ("dispatched", "handoff", "shed", "replica_down"),
+                    "route record 'status'",
+                    "an unknown status is invisible to the router "
+                    "table in summarize and to the failover smoke",
+                )
             elif v.value == "journal":
                 if not self._in_module("serving/journal.py"):
                     self.report(

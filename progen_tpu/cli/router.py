@@ -1,0 +1,447 @@
+"""Router CLI — elastic multi-replica serving front-end.
+
+Fans the same JSONL request protocol cli/serve.py speaks across N
+serve replicas (each `cli/serve --socket ... --journal_dir ...` with
+its OWN journal), and survives replica death by journal-ownership
+handoff (serving/router.py). Two ways to get a fleet:
+
+  * point at running replicas:
+
+        progen-tpu-router \
+          --replica sock=/run/r0.sock,journal=/var/r0,prom=/var/r0/m.prom \
+          --replica sock=/run/r1.sock,journal=/var/r1,prom=/var/r1/m.prom
+
+  * or spawn one (dev/smoke): ``--spawn 2 --checkpoint_path ./ckpts
+    --fleet_dir ./fleet`` starts two serve subprocesses with per-replica
+    socket/journal/prom files under ``fleet_dir/replica{i}/``;
+    ``--respawn`` restarts a dead replica with ``--replay`` of its own
+    journal — safe against double-serving because the handoff writes
+    ``handed_off`` ownership marks BEFORE any restart can replay.
+
+Requests arrive on stdin (default) or a unix socket (--socket PATH),
+exactly as cli/serve.py: one JSON object per line, ``id`` required,
+optional ``tenant`` for per-tenant quotas. Token/done/rejected events
+stream back interleaved. Shedding reasons the router adds on top of
+the replica's: ``router_queue_full``, ``tenant_quota``, ``draining``,
+``no_replicas``, ``replica_lost``.
+
+SIGTERM/SIGINT drains: intake closes, queued requests are shed with
+reason ``draining``, in-flight streams (and any handoffs their
+replicas' deaths force) run to completion, then exit 0. A second
+signal kills immediately (open request tracks are closed with reason
+``killed`` first, so the post-mortem trace is honest).
+
+Router metrics render under the ``progen_router_`` Prometheus prefix
+(--prom_file / --prom_port) and land in the tracker under ``router/``.
+
+Run: python -m progen_tpu.cli.router --spawn 2 --checkpoint_path ./ckpts
+"""
+
+from __future__ import annotations
+
+from progen_tpu.utils.env import load_env_file
+
+load_env_file()  # env flags before any heavy import (ref serve.py)
+
+import json
+import os
+import select
+import signal
+import socket as socketlib
+import subprocess
+import sys
+
+import click
+
+
+@click.command()
+@click.option("--replica", "replica_specs", multiple=True,
+              help="replica endpoint, repeatable: "
+                   "'sock=PATH[,journal=DIR][,prom=FILE][,name=N]' or a "
+                   "bare socket path (no journal = no handoff, only "
+                   "re-dispatch of never-accepted requests)")
+@click.option("--spawn", default=0,
+              help="spawn N serve replicas under --fleet_dir instead of "
+                   "connecting to --replica endpoints")
+@click.option("--checkpoint_path", default="./ckpts",
+              help="checkpoint for spawned replicas")
+@click.option("--fleet_dir", default="./fleet", type=str,
+              help="per-replica socket/journal/prom/log files land in "
+                   "FLEET_DIR/replica{i}/")
+@click.option("--respawn/--no-respawn", default=False,
+              help="restart a dead spawned replica with --replay of its "
+                   "own journal (handed-off work is skipped via its "
+                   "ownership marks)")
+@click.option("--replica-max-slots", default=8,
+              help="--max-slots for spawned replicas")
+@click.option("--replica-max-queue", default=64,
+              help="--max-queue for spawned replicas")
+@click.option("--max-len", default=None, type=int,
+              help="--max-len for spawned replicas")
+@click.option("--max-queue", default=256,
+              help="router admission queue bound (shed reason "
+                   "'router_queue_full' beyond it)")
+@click.option("--tenant_quota", default=0,
+              help="max outstanding requests per 'tenant' field "
+                   "(0 = unlimited; shed reason 'tenant_quota')")
+@click.option("--heartbeat_timeout", default=30.0, type=float,
+              help="deprioritize a replica whose prom-file heartbeat is "
+                   "older than this many seconds")
+@click.option("--socket", "socket_path", default=None, type=str,
+              help="serve a unix domain socket at PATH instead of "
+                   "stdin/stdout")
+@click.option("--metrics-every", default=0,
+              help="log a router/ metrics snapshot (and rewrite "
+                   "--prom_file) every N loop ticks (0 = only at exit)")
+@click.option("--prom_file", default=None, type=str,
+              help="write progen_router_* Prometheus text here")
+@click.option("--prom_port", default=0,
+              help="serve progen_router_* metrics over HTTP on this "
+                   "localhost port (0 = off)")
+def main(replica_specs, spawn, checkpoint_path, fleet_dir, respawn,
+         replica_max_slots, replica_max_queue, max_len, max_queue,
+         tenant_quota, heartbeat_timeout, socket_path, metrics_every,
+         prom_file, prom_port):
+    from progen_tpu import telemetry
+    from progen_tpu.resilience.chaos import install_from_env
+    from progen_tpu.serving.router import Router, parse_replica_spec
+    from progen_tpu.telemetry import (
+        prometheus_text,
+        start_prometheus_server,
+        write_prometheus,
+    )
+    from progen_tpu.tracking import make_tracker
+
+    # router chaos sites (router/connect, router/dispatch,
+    # router/handoff) arm from the environment, same as cli/serve.py
+    install_from_env()
+
+    if spawn and replica_specs:
+        sys.exit("use --spawn or --replica, not both")
+    if not spawn and not replica_specs:
+        sys.exit("no fleet: pass --replica specs or --spawn N")
+
+    procs = {}  # replica index -> (Popen, replica_dir, log file)
+
+    def _spawn_replica(i, replay=False):
+        rdir = os.path.join(fleet_dir, f"replica{i}")
+        os.makedirs(rdir, exist_ok=True)
+        args = [
+            sys.executable, "-m", "progen_tpu.cli.serve",
+            "--checkpoint_path", checkpoint_path,
+            "--socket", os.path.join(rdir, "serve.sock"),
+            "--journal_dir", rdir,
+            "--prom_file", os.path.join(rdir, "metrics.prom"),
+            "--metrics-every", "4",
+            "--max-slots", str(replica_max_slots),
+            "--max-queue", str(replica_max_queue),
+        ]
+        if max_len is not None:
+            args += ["--max-len", str(max_len)]
+        if replay:
+            args += ["--replay", rdir]
+        log = open(os.path.join(rdir, "replica.log"), "ab")
+        proc = subprocess.Popen(
+            args, stdin=subprocess.DEVNULL, stdout=log, stderr=log
+        )
+        procs[i] = (proc, rdir, log)
+        print(
+            f"replica{i}: pid {proc.pid}"
+            + (" (replaying its journal)" if replay else ""),
+            file=sys.stderr,
+        )
+
+    if spawn:
+        specs = []
+        for i in range(spawn):
+            rdir = os.path.join(fleet_dir, f"replica{i}")
+            specs.append(parse_replica_spec(
+                f"sock={os.path.join(rdir, 'serve.sock')},"
+                f"journal={rdir},"
+                f"prom={os.path.join(rdir, 'metrics.prom')}"
+            ))
+            _spawn_replica(i)
+    else:
+        specs = [parse_replica_spec(s) for s in replica_specs]
+
+    router = Router(
+        specs, max_queue=max_queue, tenant_quota=tenant_quota,
+        heartbeat_timeout=heartbeat_timeout,
+    )
+    tracker = make_tracker("progen-router")
+    telemetry.configure(sink=tracker.log_event)
+    run_dir = getattr(tracker, "path", None)
+    if run_dir is not None:
+        print(
+            f"router traces: {run_dir}/events.jsonl "
+            "(render with progen-tpu-telemetry export-trace)",
+            file=sys.stderr,
+        )
+
+    def publish(step=None):
+        router.metrics.log_to(tracker, step=step, prefix="router/")
+        if prom_file:
+            write_prometheus(
+                prom_file,
+                prometheus_text(router.metrics, prefix="progen_router_"),
+            )
+
+    prom_srv = None
+    if prom_port:
+        prom_srv = start_prometheus_server(
+            lambda: prometheus_text(
+                router.metrics, prefix="progen_router_"
+            ),
+            port=prom_port,
+        )
+        print(
+            f"prometheus on http://127.0.0.1:"
+            f"{prom_srv.server_address[1]}/metrics",
+            file=sys.stderr,
+        )
+    print(
+        f"routing across {len(specs)} replica(s): "
+        + ", ".join(s.socket_path for s in specs),
+        file=sys.stderr,
+    )
+
+    shutdown = {"flag": False}
+
+    def _request_drain(signum, frame):
+        if shutdown["flag"]:
+            print(f"signal {signum} again: exiting now", file=sys.stderr)
+            try:
+                router.close_tracks("killed")
+            except Exception:
+                pass  # a torn trace line beats a hung exit
+            sys.stderr.flush()
+            os._exit(1)
+        shutdown["flag"] = True
+        print(
+            f"signal {signum}: draining — intake closed, queued requests "
+            "shed, in-flight streams finishing; signal again to kill",
+            file=sys.stderr,
+        )
+
+    def tick():
+        """Once per front-loop iteration, AFTER router.poll() — so a
+        dead spawned replica's handoff (triggered by the socket EOF
+        inside poll) has already written its ownership marks before any
+        --respawn replay can read the journal."""
+        if shutdown["flag"]:
+            return
+        for i, (proc, rdir, log) in list(procs.items()):
+            if proc.poll() is None:
+                continue
+            del procs[i]
+            log.close()
+            print(
+                f"replica{i}: exited rc={proc.returncode}",
+                file=sys.stderr,
+            )
+            if respawn and not router.links[i].up:
+                _spawn_replica(i, replay=True)
+
+    old_term = signal.signal(signal.SIGTERM, _request_drain)
+    old_int = signal.signal(signal.SIGINT, _request_drain)
+    try:
+        if socket_path:
+            _front_socket(router, socket_path, publish, metrics_every,
+                          shutdown, tick=tick)
+        else:
+            _front_stdio(router, publish, metrics_every, shutdown,
+                         tick=tick)
+    finally:
+        signal.signal(signal.SIGTERM, old_term)
+        signal.signal(signal.SIGINT, old_int)
+        publish()
+        if prom_srv is not None:
+            prom_srv.shutdown()
+        for i, (proc, rdir, log) in procs.items():
+            proc.terminate()
+        for i, (proc, rdir, log) in procs.items():
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+            log.close()
+        telemetry.configure()  # detach before the sink closes
+        tracker.finish()
+
+
+def _submit_obj(router, line, client=None):
+    """Parse + submit one request line; returns a rejection event dict
+    to answer immediately, or None."""
+    try:
+        obj = json.loads(line)
+        if not isinstance(obj, dict):
+            raise ValueError("request must be a JSON object")
+    except ValueError as e:
+        return {"event": "rejected", "id": None,
+                "reason": f"bad request line: {e}"}
+    return router.submit(obj, client=client)
+
+
+def _front_stdio(router, publish, metrics_every, shutdown, tick=None):
+    """stdin-JSONL front: one select loop over {stdin, replica sockets}
+    — new requests and replica events interleave without polling sleeps.
+    Same raw-fd line buffering as cli/serve.py (select()+readline()
+    loses lines). EOF or a drain signal closes intake; the loop runs
+    until the router settles everything it accepted."""
+    out = sys.stdout
+    eof = False
+    drained = False
+    buf = ""
+    ticks = 0
+
+    def emit(ev):
+        out.write(json.dumps(ev) + "\n")
+        out.flush()
+
+    while True:
+        if shutdown["flag"] and not drained:
+            drained = True
+            router.drain()
+        if (eof or shutdown["flag"]) and not router.has_work:
+            break
+        rlist = ([] if (eof or shutdown["flag"]) else [sys.stdin])
+        rlist += router.fds()
+        # bounded wait: backoffs/reconnects need the loop to turn even
+        # when no fd is hot
+        timeout = 0.05 if router.has_work else 0.2
+        try:
+            if rlist:
+                select.select(rlist, [], [], timeout)
+        except OSError:
+            pass  # a replica socket died between fds() and select
+        while not eof and not shutdown["flag"]:
+            nl = buf.find("\n")
+            if nl < 0:
+                try:
+                    ready, _, _ = select.select([sys.stdin], [], [], 0.0)
+                except OSError:
+                    break
+                if not ready:
+                    break
+                data = os.read(sys.stdin.fileno(), 65536)
+                if not data:
+                    eof = True
+                    line, buf = buf, ""
+                else:
+                    buf += data.decode("utf-8", errors="replace")
+                    continue
+            else:
+                line, buf = buf[:nl], buf[nl + 1:]
+            if not line.strip():
+                continue
+            rej = _submit_obj(router, line)
+            if rej is not None:
+                emit(rej)
+        for _, ev in router.poll():
+            emit(ev)
+        if tick is not None:
+            tick()
+        ticks += 1
+        if metrics_every and ticks % metrics_every == 0:
+            publish(ticks)
+
+
+def _front_socket(router, socket_path, publish, metrics_every, shutdown,
+                  tick=None):
+    """Unix-socket front: each connection submits requests and receives
+    exactly its own events (the router's per-request ``client`` handle
+    is the connection fd). On drain the listener closes, the queue is
+    shed, in-flight streams finish to their clients, then exit."""
+    if os.path.exists(socket_path):
+        os.unlink(socket_path)
+    srv = socketlib.socket(socketlib.AF_UNIX, socketlib.SOCK_STREAM)
+    srv.bind(socket_path)
+    srv.listen(16)
+    srv.setblocking(False)
+    clients = {}  # fd -> (sock, recv_buffer)
+    ticks = 0
+    drained = False
+    print(f"listening on {socket_path}", file=sys.stderr)
+
+    def send(fd, ev):
+        sock, _ = clients.get(fd, (None, None))
+        if sock is None:
+            return
+        try:
+            sock.sendall(json.dumps(ev).encode() + b"\n")
+        except OSError:
+            _drop(fd)
+
+    def _drop(fd):
+        sock, _ = clients.pop(fd, (None, None))
+        if sock is not None:
+            sock.close()
+
+    try:
+        while True:
+            if shutdown["flag"] and not drained:
+                drained = True
+                srv.close()  # refuse new connections during drain
+                router.drain()
+            if shutdown["flag"] and not router.has_work:
+                break
+            rlist = ([] if drained else [srv])
+            rlist += [s for s, _ in clients.values()]
+            rlist += router.fds()
+            timeout = 0.05 if router.has_work else 0.2
+            try:
+                ready, _, _ = (
+                    select.select(rlist, [], [], timeout)
+                    if rlist else ([], [], [])
+                )
+            except OSError:
+                continue  # a peer vanished between list and select
+            replica_socks = set(router.fds())
+            for sock in ready:
+                if sock is srv:
+                    conn, _ = srv.accept()
+                    conn.setblocking(False)
+                    clients[conn.fileno()] = (conn, b"")
+                    continue
+                if sock in replica_socks:
+                    continue  # router.poll() below reads these
+                fd = sock.fileno()
+                if fd not in clients:
+                    continue
+                try:
+                    data = sock.recv(65536)
+                except OSError:
+                    data = b""
+                if not data:
+                    _drop(fd)
+                    continue
+                _, cbuf = clients[fd]
+                cbuf += data
+                *lines, cbuf = cbuf.split(b"\n")
+                clients[fd] = (sock, cbuf)
+                for raw in lines:
+                    if not raw.strip():
+                        continue
+                    rej = _submit_obj(
+                        router, raw.decode("utf-8", "replace"), client=fd
+                    )
+                    if rej is not None:
+                        send(fd, rej)
+            for client, ev in router.poll():
+                if client is not None:
+                    send(client, ev)
+            if tick is not None:
+                tick()
+            ticks += 1
+            if metrics_every and ticks % metrics_every == 0:
+                publish(ticks)
+    finally:
+        for fd in list(clients):
+            _drop(fd)
+        srv.close()
+        if os.path.exists(socket_path):
+            os.unlink(socket_path)
+
+
+if __name__ == "__main__":
+    main()

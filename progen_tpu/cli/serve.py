@@ -7,7 +7,12 @@ event loop. Requests arrive as JSON lines, one object per request:
      "temperature": 0.8, "top_p": 0.95, "top_k": 25, "seed": 7}
 
 (``id`` and ``prime`` required; everything else optional — ``length``
-defaults to --max-len.) Responses stream back as JSON lines, one per
+defaults to --max-len.) The router's resume wire (serving/router.py)
+uses three extra optional fields: ``prime_tokens`` (raw token ids,
+bypassing the tokenizer), ``key`` (explicit uint32 PRNG key pair) and
+``add_bos`` (default true) — together they let a handed-off request
+continue bit-identically on another replica. Responses stream back as
+JSON lines, one per
 event, interleaved across requests as the engine produces them:
 
     {"event": "token", "id": "r1", "token": 77, "text": "L", "index": 18}
@@ -65,22 +70,41 @@ def _parse_request(line, defaults):
     except (ValueError, KeyError) as e:
         return None, f"bad request line: {e}"
     try:
-        prime = np.asarray(
-            encode_tokens(str(obj.get("prime", ""))), dtype=np.int32
-        )
+        if obj.get("prime_tokens") is not None:
+            # raw token ids: the router's resume wire (already-tokenized
+            # prefix of a handed-off request) — bypasses the tokenizer
+            prime = np.asarray(
+                [int(t) for t in obj["prime_tokens"]], dtype=np.int32
+            )
+        else:
+            prime = np.asarray(
+                encode_tokens(str(obj.get("prime", ""))), dtype=np.int32
+            )
+        key = None
+        if obj.get("key") is not None:
+            # explicit PRNG key (raw uint32 pair): resumed requests must
+            # continue the EXACT stream, not restart a seed
+            import jax.numpy as jnp
+
+            key = jnp.asarray(
+                [int(k) for k in obj["key"]], dtype=jnp.uint32
+            )
         req = Request(
             id=rid,
             prime=prime,
             length=int(obj.get("length", defaults["length"])),
             top_k=(None if obj.get("top_k", defaults["top_k"]) is None
                    else int(obj.get("top_k", defaults["top_k"]))),
-            add_bos=True,  # server parity with cli/sample.py
+            # default True: server parity with cli/sample.py; resumed
+            # requests carry their journaled add_bos explicitly
+            add_bos=bool(obj.get("add_bos", True)),
             temperature=float(
                 obj.get("temperature", defaults["temperature"])
             ),
             top_p=(None if obj.get("top_p", defaults["top_p"]) is None
                    else float(obj.get("top_p", defaults["top_p"]))),
             seed=int(obj.get("seed", defaults["seed"])),
+            key=key,
             deadline_s=(None if obj.get("deadline_s") is None
                         else float(obj["deadline_s"])),
         )
@@ -247,6 +271,15 @@ def main(checkpoint_path, max_slots, max_queue, max_len, quantize_int8,
         )
 
     def publish(step=None):
+        # compile counts ride the metrics: the router's kill-matrix
+        # reads the survivor's prom file to prove handoff didn't trigger
+        # a recompile (resume state is shape-identical to fresh intake)
+        sched.metrics.set_gauge(
+            "prefill_compile_count", engine.prefill_compile_count()
+        )
+        sched.metrics.set_gauge(
+            "decode_compile_count", engine.decode_compile_count()
+        )
         sched.metrics.log_to(tracker, step=step)
         if prom_file:
             write_prometheus(prom_file, prometheus_text(sched.metrics))
@@ -371,6 +404,11 @@ def main(checkpoint_path, max_slots, max_queue, max_len, quantize_int8,
         signal.signal(signal.SIGINT, old_int)
         signal.signal(signal.SIGHUP, old_hup)
         publish()
+        print(
+            f"compile counts: prefill={engine.prefill_compile_count()} "
+            f"decode={engine.decode_compile_count()}",
+            file=sys.stderr,
+        )
         if prom_srv is not None:
             prom_srv.shutdown()
         telemetry.configure()  # detach before the sink closes
@@ -479,7 +517,7 @@ def _serve_stdio(sched, defaults, publish, metrics_every, shutdown,
             if rej is not None:
                 emit([rej])
             else:
-                starts[req.id] = len(req.prime) + 1  # add_bos
+                starts[req.id] = len(req.prime) + (1 if req.add_bos else 0)
         if sched.has_work:
             events, comps = sched.step()
             emit(_events_to_lines(events, comps, starts))
@@ -580,7 +618,9 @@ def _serve_socket(sched, defaults, socket_path, publish, metrics_every,
                         ok, reason = sched.submit(req)
                         if ok:
                             owners[req.id] = (fd, public)
-                            starts[req.id] = len(req.prime) + 1
+                            starts[req.id] = (
+                                len(req.prime) + (1 if req.add_bos else 0)
+                            )
                             continue
                         err = reason
                         public_id = public

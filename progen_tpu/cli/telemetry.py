@@ -239,6 +239,7 @@ def summarize_cmd(events, metrics, top_spans):
     timings: dict = {}
     counts: dict = {}
     open_req: dict = {}
+    routes: list = []
     for rec in iter_jsonl(events, drops):
         ev = rec.get("ev")
         if ev == "E" and "dur_s" in rec:
@@ -259,6 +260,8 @@ def summarize_cmd(events, metrics, top_spans):
                     ).observe(float(rec["ts"]) - float(t0))
         elif ev not in ("B", "E", None):
             counts[str(ev)] = counts.get(str(ev), 0) + 1
+            if ev == "route":
+                routes.append(rec)
 
     if timings:
         click.echo("== span latency (s) ==")
@@ -279,11 +282,77 @@ def summarize_cmd(events, metrics, top_spans):
             click.echo(f"... {len(families) - top_spans} more (--spans)")
         click.echo("")
 
+    if routes:
+        # the router's routing-decision records (serving/router.py):
+        # one per dispatch/handoff/shed/replica-death, replica-attributed
+        per: dict = {}
+
+        def _row(i):
+            return per.setdefault(int(i), {
+                "routed": 0, "retried": 0, "handoff_in": 0,
+                "handoff_out": 0, "shed": 0, "down": 0,
+            })
+
+        shed_router = 0
+        for r in routes:
+            st = r.get("status")
+            if st == "dispatched" and r.get("replica") is not None:
+                _row(r["replica"])["routed"] += 1
+                if r.get("retry"):
+                    _row(r["replica"])["retried"] += 1
+            elif st == "handoff":
+                if r.get("from") is not None:
+                    _row(r["from"])["handoff_out"] += 1
+                if r.get("to") is not None:
+                    _row(r["to"])["handoff_in"] += 1
+            elif st == "shed":
+                if r.get("replica") is not None:
+                    _row(r["replica"])["shed"] += 1
+                else:
+                    shed_router += 1  # shed before any replica owned it
+            elif st == "replica_down" and r.get("replica") is not None:
+                _row(r["replica"])["down"] += 1
+        click.echo("== router (per replica) ==")
+        click.echo(
+            f"{'replica':>7} {'routed':>7} {'retried':>8} "
+            f"{'handoff_in':>11} {'handoff_out':>12} {'shed':>5} "
+            f"{'down':>5}"
+        )
+        for i in sorted(per):
+            p = per[i]
+            click.echo(
+                f"{i:>7} {p['routed']:>7} {p['retried']:>8} "
+                f"{p['handoff_in']:>11} {p['handoff_out']:>12} "
+                f"{p['shed']:>5} {p['down']:>5}"
+            )
+        if shed_router:
+            click.echo(f"shed at the router (no replica): {shed_router}")
+        click.echo("")
+
     serve_row = None
+    router_row = None
     if metrics is not None and Path(metrics).exists():
         for rec in iter_jsonl(metrics, drops):
             if any(k.startswith("serve/") for k in rec):
                 serve_row = rec  # last snapshot wins (cumulative)
+            if any(k.startswith("router/") for k in rec):
+                router_row = rec
+    if router_row is not None:
+        click.echo("== fleet request latency (s) ==")
+        click.echo(
+            f"{'metric':<12} {'count':>6} {'p50':>9} {'p95':>9} {'p99':>9}"
+        )
+        for fam in ("ttft_s", "latency_s"):
+            if f"router/{fam}_count" not in router_row:
+                continue
+            click.echo(
+                f"{fam:<12} "
+                f"{int(router_row[f'router/{fam}_count']):>6} "
+                f"{router_row.get(f'router/{fam}_p50_s', 0.0):>9.4f} "
+                f"{router_row.get(f'router/{fam}_p95_s', 0.0):>9.4f} "
+                f"{router_row.get(f'router/{fam}_p99_s', 0.0):>9.4f}"
+            )
+        click.echo("")
     if serve_row is not None:
         click.echo("== serving latency (s) ==")
         click.echo(

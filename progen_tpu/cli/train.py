@@ -225,6 +225,7 @@ def main(
         ROLLBACK,
         SPIKE,
         LossSentinel,
+        PoisonBisector,
         consistent_flag,
     )
 
@@ -529,8 +530,14 @@ def main(
     # it ahead past the offending window, so the loop is a while over it
     seq_cursor = start_seq_index
     steps_done = 0
-    rollbacks_done = 0
+    rollbacks_done = 0  # distinct poison WINDOWS rolled back
     max_rollbacks = 3  # a third relapse means skipping isn't fixing it
+    # bisection probes inside one window each cost a restore but don't
+    # count as a new window; the backstop bounds total restores anyway
+    total_rollbacks = 0
+    max_total_rollbacks = max_rollbacks * 4
+    bisector = None  # PoisonBisector over the current poison window
+    bisect_start = 0  # seq_cursor where that window begins
     sentinel = LossSentinel(factor=anomaly_factor, patience=anomaly_patience)
     profiler_active = False
     # metric step continues across resumes (state.step is checkpointed);
@@ -807,12 +814,31 @@ def main(
             i += 1
             pbar.update(effective_batch)
           except AnomalyRollback as exc:
-            rollbacks_done += 1
+            total_rollbacks += 1
             pending = None  # the queued step's metrics are the anomaly
             step_at, bad_loss = exc.args
-            if rollbacks_done > max_rollbacks:
+            # same poison window re-spiking (the resume landed before
+            # the poison), or a NEW window? Re-spikes near the current
+            # window feed the bisector; anything else opens a fresh one
+            same_window = (
+                bisector is not None
+                and not bisector.exhausted
+                and seq_cursor < bisect_start + 3 * effective_batch
+            )
+            if same_window:
+                bisector.observe_respike()
+            else:
+                rollbacks_done += 1
+                bisect_start = seq_cursor
+                bisector = PoisonBisector(
+                    effective_batch, min_step=batch_size
+                )
+            if (
+                rollbacks_done > max_rollbacks
+                or total_rollbacks > max_total_rollbacks
+            ):
                 raise RuntimeError(
-                    f"{rollbacks_done} anomaly rollbacks without recovery "
+                    f"{total_rollbacks} anomaly rollbacks without recovery "
                     f"(last loss {bad_loss} at step {step_at}); skipping "
                     "data is not fixing this — inspect the stream/hparams"
                 ) from exc
@@ -833,11 +859,15 @@ def main(
                         "but no checkpoint exists to roll back to"
                     ) from exc
                 state = pkg.state
-                # skip ahead PAST the offending window: the stream
-                # resumes one effective batch beyond where the anomaly
-                # surfaced, not where the checkpoint left off —
-                # re-feeding the same records would just spike again
-                seq_cursor = seq_cursor + effective_batch
+                # skip ahead INTO the offending window, not past it:
+                # the bisector proposes the smallest prefix-skip worth
+                # trying (half the remaining window, aligned to one
+                # per-device batch); if the poison is past the resume
+                # point the window re-spikes and the next probe skips
+                # more — exhaustion degrades to the legacy whole-window
+                # discard, so clean tail data is salvaged, never lost
+                skip = bisector.propose()
+                seq_cursor = bisect_start + skip
                 train_ds.close()
                 train_ds = train_iter_fn(
                     config.seq_len,
@@ -857,7 +887,9 @@ def main(
                     step_at,
                     f"anomaly rollback {rollbacks_done}/{max_rollbacks}: "
                     f"restored checkpoint (state step {restored_step}), "
-                    f"data skipped ahead to sequence {seq_cursor}",
+                    f"data skipped ahead to sequence {seq_cursor} "
+                    f"(bisect: {skip}/{bisector.window} of the window "
+                    f"discarded, {bisector.salvaged} salvaged)",
                 )
             reg.inc("anomaly_rollbacks")
             telemetry.get_telemetry().emit({
@@ -866,6 +898,10 @@ def main(
                 "restored_step": restored_step,
                 "next_seq_index": seq_cursor,
                 "rollbacks_done": rollbacks_done,
+                "total_rollbacks": total_rollbacks,
+                "bisect_skip": skip,
+                "bisect_window": bisector.window,
+                "bisect_salvaged": bisector.salvaged,
             })
             pbar.update(effective_batch)
             if watchdog is not None:

@@ -12,7 +12,12 @@ sentinel implements the staged response production runs actually want
   2. N CONSECUTIVE anomalies mean the stream or the state is bad in a
      way skipping won't fix: the sentinel escalates to ``rollback`` —
      the loop restores the last good checkpoint and skips ahead in the
-     data stream past the offending window.
+     data stream past the offending window;
+  3. the skipped window is then BISECTED (``PoisonBisector``) instead
+     of discarded whole: each time the same window re-spikes after a
+     resume, the skip offset grows toward the full window, converging
+     on the smallest prefix-skip that clears the poison — the clean
+     tail of the window is salvaged rather than thrown away.
 
 Statistics: Welford-style EMA of loss with an EMA of absolute deviation
 (robust to the very spikes being detected — a spiky sample never enters
@@ -94,6 +99,71 @@ class LossSentinel:
             self.mean = self.beta * self.mean + (1 - self.beta) * loss
         self.n_clean += 1
         return OK
+
+
+class PoisonBisector:
+    """Find the smallest prefix of a poisoned data window to skip.
+
+    A rollback used to discard one whole effective batch of sequences
+    (``[start, start + window)``). Most of that window is usually clean
+    — the poison is a few records. The bisector proposes skip offsets
+    into the window: the first probe resumes halfway in; if the window
+    re-spikes, the skip that proved insufficient becomes the new lower
+    bound and the next probe lands halfway through what remains. Each
+    probe costs one checkpoint restore, so convergence is logarithmic
+    in ``window / min_step`` (``min_step`` = the data iterator's skip
+    granularity, typically one per-device batch). When the interval
+    collapses, ``exhausted`` is set and the final proposal is the full
+    window — exactly the legacy discard-it-whole behavior, so bisection
+    can only ever salvage data, never lose more.
+
+    Protocol (cli/train.py's rollback handler):
+
+        b = PoisonBisector(window=effective_batch, min_step=batch_size)
+        skip = b.propose()            # resume at start + skip
+        ... training re-spikes in the same window ...
+        b.observe_respike()           # that skip was insufficient
+        skip = b.propose()            # larger skip, same window
+        ... training runs clean -> the tail [skip, window) was salvaged
+    """
+
+    def __init__(self, window: int, min_step: int = 1):
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self.window = int(window)
+        self.min_step = max(1, int(min_step))
+        self.lo = 0  # largest skip that re-spiked (known insufficient)
+        self._last: Optional[int] = None
+        # a window no wider than one step can't be split
+        self.exhausted = self.window <= self.min_step
+
+    def propose(self) -> int:
+        """Next skip offset to try, in ``(0, window]``; aligned to
+        ``min_step`` except for the terminal full-window proposal."""
+        if self.exhausted or self.window - self.lo <= self.min_step:
+            self.exhausted = True
+            self._last = self.window
+            return self.window
+        span = self.window - self.lo
+        half = max(
+            self.min_step, (span // 2 // self.min_step) * self.min_step
+        )
+        self._last = min(self.lo + half, self.window)
+        return self._last
+
+    def observe_respike(self) -> None:
+        """The window spiked again after resuming at the last proposed
+        skip: the poison extends past it."""
+        if self._last is None:
+            return
+        self.lo = self._last
+        if self.window - self.lo <= self.min_step:
+            self.exhausted = True
+
+    @property
+    def salvaged(self) -> int:
+        """Sequences of the window NOT discarded by the last proposal."""
+        return self.window - (self._last or self.window)
 
 
 def consistent_flag(flag: bool) -> bool:

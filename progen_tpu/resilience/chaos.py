@@ -45,6 +45,20 @@ Serving targets (the serve kill-matrix, tests/test_serve_kill_matrix):
                                host-side rebind, so it either fully
                                applied or never happened).
 
+Router targets (the fleet kill-matrix, tests/test_router_kill_matrix):
+
+  * ``router/connect``   — replica socket connect (``fail@N``/``prob``
+                           = a refused/flaky replica; the circuit
+                           breaker must absorb it);
+  * ``router/dispatch``  — just before a request line is written to a
+                           replica (transient ``fail@N`` = re-route on
+                           the backoff schedule; ``kill@N`` = the
+                           ROUTER dies mid-dispatch);
+  * ``router/handoff``   — span entry of the journal-ownership handoff
+                           after a replica death (a fault here must not
+                           lose the dead replica's in-flight work —
+                           the fold is idempotent and is retried).
+
 An unknown target (typo'd span name, renamed site) warns ONCE at
 install instead of silently never firing — a chaos rehearsal whose
 faults never land proves nothing.
@@ -67,6 +81,7 @@ from progen_tpu.resilience.retry import TransientError
 KNOWN_TARGETS = frozenset({
     # spans
     "ckpt/finalize", "ckpt/restore", "ckpt/restore_params", "ckpt/save",
+    "router/handoff",
     "serve/prefill", "serve/reload", "serve/reload_commit",
     "train/ckpt", "train/compile", "train/eval", "train/rollback",
     "train/sample",
@@ -76,7 +91,7 @@ KNOWN_TARGETS = frozenset({
     # perturb sites
     "train/loss",
     # direct maybe_inject sites
-    "serve/decode",
+    "router/connect", "router/dispatch", "serve/decode",
 })
 
 _WARNED_UNKNOWN: set = set()

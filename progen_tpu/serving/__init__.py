@@ -13,14 +13,18 @@ Zero-downtime extras (journal.py / reload.py): give the scheduler a
 ``RequestJournal`` and accepted work survives a kill (``replay_into``
 resumes it bit-identically); give the serve loop a ``WeightReloader``
 and checkpoints hot-swap between decode steps without recompiling.
+Scale-out (router.py): a `Router` fans requests across N such engines
+and hands a dead replica's journal-accepted work to survivors.
 """
 
 from progen_tpu.serving.engine import PreparedParams, ServeEngine, SlotBatch
 from progen_tpu.serving.journal import (
     RequestJournal,
+    handoff_states,
     replay_into,
     replay_requests,
 )
+from progen_tpu.serving.router import ReplicaSpec, Router, parse_replica_spec
 from progen_tpu.serving.metrics import ServingMetrics
 from progen_tpu.serving.reload import WeightReloader
 from progen_tpu.serving.scheduler import (
@@ -44,6 +48,10 @@ __all__ = [
     "Completion",
     "RequestJournal",
     "WeightReloader",
+    "Router",
+    "ReplicaSpec",
+    "parse_replica_spec",
+    "handoff_states",
     "replay_into",
     "replay_requests",
     "REJECT_QUEUE_FULL",

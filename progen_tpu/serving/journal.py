@@ -29,6 +29,15 @@ engine itself is pinned to. Resumed requests are re-journaled as fresh
 accepts (compound prime, advanced key), so replay composes: a second
 crash replays from the second accept without revisiting the first.
 
+The journal is also the unit of OWNERSHIP in a multi-replica fleet
+(serving/router.py): a request belongs to whichever journal holds its
+unsettled ``accept``. When a replica dies, the router folds that
+replica's journal (``handoff_states``), re-routes the unfinished
+requests to survivors, and appends a ``done`` record with status
+``handed_off`` — from that record on, the dead journal will never
+answer the request again, so a restart with ``--replay`` and the
+router's re-route can never double-serve it.
+
 The ``op`` grammar and the raw-record privilege live HERE (linted by
 PGL006): any other module wanting journal records goes through
 RequestJournal, not hand-rolled dicts.
@@ -37,6 +46,7 @@ RequestJournal, not hand-rolled dicts.
 from __future__ import annotations
 
 import json
+import re
 import threading
 import time
 from pathlib import Path
@@ -49,6 +59,8 @@ from progen_tpu.telemetry.spans import get_telemetry
 from progen_tpu.telemetry.trace import LineDrops, iter_jsonl
 
 STATUS_COMPLETED = "completed"
+# ownership transferred to the router: settled HERE, answered elsewhere
+STATUS_HANDED_OFF = "handed_off"
 
 
 class RequestJournal:
@@ -127,17 +139,22 @@ def _advance_key(key, n: int):
     return key
 
 
-def _read_state(path, drops: Optional[LineDrops] = None) -> dict:
+def _read_state(path, drops: Optional[LineDrops] = None,
+                normalize=None) -> dict:
     """Fold the journal into per-request state. Re-accepts (a replayed
     run re-journals resumed requests) overwrite the resume parameters;
     token watermarks accumulate by index across accepts — the indices of
     successive rounds never overlap because each re-accept folds prior
-    tokens into its prime."""
+    tokens into its prime. ``normalize`` optionally rewrites request ids
+    before folding (the router strips connection namespaces so accepts
+    across socket connections fold like same-id re-accepts)."""
     state: dict = {}
     for rec in iter_jsonl(path, drops):
         if rec.get("ev") != "journal":
             continue
         rid = rec.get("req")
+        if normalize is not None:
+            rid = normalize(rid)
         entry = state.setdefault(
             rid, {"accept": None, "tokens": {}, "done": None}
         )
@@ -149,6 +166,104 @@ def _read_state(path, drops: Optional[LineDrops] = None) -> dict:
         elif op == "done":
             entry["done"] = rec
     return state
+
+
+def _classify(entry: dict) -> dict:
+    """One folded request (with an ``accept``) -> resume state. ``kind``
+    is ``done`` (terminal record present), ``finished`` (the journaled
+    stream already satisfies the stop rule — hit length, or emitted the
+    second zero), or ``pending`` (resumable mid-stream)."""
+    acc = entry["accept"]
+    prime = [int(t) for t in acc["prime"]]
+    add_bos = bool(acc.get("add_bos", False))
+    start = len(prime) + (1 if add_bos else 0)
+    # contiguous emitted run from this accept's first write position
+    emitted: List[int] = []
+    while start + len(emitted) in entry["tokens"]:
+        emitted.append(entry["tokens"][start + len(emitted)])
+    length = int(acc["length"])
+    zeros = (
+        (1 if add_bos else 0)
+        + sum(1 for t in prime if t == 0)
+        + sum(1 for t in emitted if t == 0)
+    )
+    if entry["done"] is not None:
+        kind = "done"
+    elif start + len(emitted) >= length or zeros >= 2:
+        kind = "finished"
+    else:
+        kind = "pending"
+    return {
+        "kind": kind, "accept": acc, "emitted": emitted, "start": start,
+        "length": length, "done": entry["done"],
+    }
+
+
+def resume_request(rid: str, cls: dict) -> Request:
+    """Build the resubmittable Request for a ``pending`` classification:
+    prime = original prime + every journaled token, key fast-forwarded
+    one split per emitted token, same length/knobs — the bit-identical
+    resume contract (deadline intentionally dropped: it measured queue
+    wait in the DEAD process; re-applying it would shed the very
+    requests recovery exists to save)."""
+    import jax.numpy as jnp
+
+    acc = cls["accept"]
+    prime = [int(t) for t in acc["prime"]]
+    key = _advance_key(
+        jnp.asarray(acc["key"], jnp.uint32), len(cls["emitted"])
+    )
+    return Request(
+        id=rid,
+        prime=np.asarray(prime + cls["emitted"], np.int32),
+        length=cls["length"],
+        top_k=acc.get("top_k"),
+        add_bos=bool(acc.get("add_bos", False)),
+        temperature=float(acc.get("temperature", 1.0)),
+        top_p=acc.get("top_p"),
+        key=key,
+        deadline_s=None,
+    )
+
+
+# socket-transport journals namespace ids per connection: "{fd}:{id}"
+_CONN_NS_RE = re.compile(r"^\d+:")
+
+
+def handoff_states(path, drops: Optional[LineDrops] = None) -> dict:
+    """Router-side ownership view of a (dead) replica's journal: every
+    journaled request classified for handoff. Returns ``{rid: cls}``
+    where ``cls`` is ``_classify`` output plus ``"jids"`` — the raw
+    (connection-namespaced) journal ids that contributed, which is what
+    a ``handed_off`` ownership mark must be written against so a later
+    ``--replay`` of the same journal skips them.
+
+    Ids are normalized by stripping the ``{fd}:`` connection namespace,
+    so a request the router re-dispatched to the SAME replica over a
+    later connection folds with its first accept exactly like an
+    in-process re-accept does."""
+    jids: dict = {}
+
+    def norm(rid):
+        rid = str(rid)
+        base = rid.split(":", 1)[1] if _CONN_NS_RE.match(rid) else rid
+        jids.setdefault(base, set()).add(rid)
+        return base
+
+    out: dict = {}
+    for rid, entry in _read_state(path, drops, normalize=norm).items():
+        if entry["accept"] is None:
+            if entry["done"] is None:
+                continue  # tokens without an accept: torn journal head
+            cls = {
+                "kind": "done", "accept": None, "emitted": [],
+                "start": 0, "length": 0, "done": entry["done"],
+            }
+        else:
+            cls = _classify(entry)
+        cls["jids"] = sorted(jids.get(rid, {rid}))
+        out[rid] = cls
+    return out
 
 
 def replay_requests(
@@ -168,8 +283,6 @@ def replay_requests(
       * ``n_done`` — requests with a terminal record, skipped entirely
         (the dedup half of the zero-duplicate guarantee).
     """
-    import jax.numpy as jnp
-
     pending: List[Request] = []
     finished: List[dict] = []
     n_done = 0
@@ -177,44 +290,16 @@ def replay_requests(
         if entry["done"] is not None:
             n_done += 1
             continue
-        acc = entry["accept"]
-        if acc is None:
+        if entry["accept"] is None:
             continue  # tokens without an accept: torn journal head
-        prime = [int(t) for t in acc["prime"]]
-        add_bos = bool(acc.get("add_bos", False))
-        start = len(prime) + (1 if add_bos else 0)
-        # contiguous emitted run from this accept's first write position
-        emitted: List[int] = []
-        while start + len(emitted) in entry["tokens"]:
-            emitted.append(entry["tokens"][start + len(emitted)])
-        length = int(acc["length"])
-        zeros = (
-            (1 if add_bos else 0)
-            + sum(1 for t in prime if t == 0)
-            + sum(1 for t in emitted if t == 0)
-        )
-        if start + len(emitted) >= length or zeros >= 2:
+        cls = _classify(entry)
+        if cls["kind"] == "finished":
             finished.append(
-                {"id": rid, "emitted": emitted, "accept": acc}
+                {"id": rid, "emitted": cls["emitted"],
+                 "accept": cls["accept"]}
             )
-            continue
-        key = _advance_key(
-            jnp.asarray(acc["key"], jnp.uint32), len(emitted)
-        )
-        pending.append(Request(
-            id=rid,
-            prime=np.asarray(prime + emitted, np.int32),
-            length=length,
-            top_k=acc.get("top_k"),
-            add_bos=add_bos,
-            temperature=float(acc.get("temperature", 1.0)),
-            top_p=acc.get("top_p"),
-            key=key,
-            # deadline intentionally dropped: it measured queue wait in
-            # the DEAD process; re-applying it here would shed the very
-            # requests replay exists to save
-            deadline_s=None,
-        ))
+        else:
+            pending.append(resume_request(rid, cls))
     return pending, finished, n_done
 
 

@@ -119,9 +119,11 @@ class ServingMetrics:
             },
         }
 
-    def log_to(self, tracker, step: Optional[int] = None) -> None:
+    def log_to(self, tracker, step: Optional[int] = None,
+               prefix: str = "serve/") -> None:
         """Emit the snapshot through a tracking.py tracker (Jsonl/wandb/
-        Noop all share the ``log(dict, step)`` shape)."""
+        Noop all share the ``log(dict, step)`` shape). The router logs
+        the same registry shape under ``router/``."""
         tracker.log(
-            {f"serve/{k}": v for k, v in self.snapshot().items()}, step
+            {f"{prefix}{k}": v for k, v in self.snapshot().items()}, step
         )

@@ -1,0 +1,808 @@
+"""Multi-replica failover router: one front, N journaled ServeEngines.
+
+One `ServeEngine` process scales UP (slots, int8, fused kernels); this
+module scales OUT, Orca-style: request-level routing in front of
+iteration-level scheduling, each replica running `cli/serve --socket`
+with its OWN journal directory. The router is a pure front-end — no
+model, no device — so its failure domain is tiny and its loop is IO.
+
+Routing: least-loaded UP replica (router-tracked in-flight plus the
+queue depth scraped from the replica's Prometheus file), lowest index
+as the deterministic tiebreak. Health is passive: the replica's prom
+file mtime is its heartbeat (stale replicas are deprioritized, not
+evicted), and a broken/refused socket is the hard down signal. Each
+replica has a circuit breaker whose open-interval follows
+`resilience/retry.py` policy semantics — exponential backoff with
+seeded jitter, saturating at `max_delay_s` so a dead replica keeps
+being re-probed forever (elasticity: a replica that comes back simply
+gets dialed again).
+
+Load shedding is explicit, like the scheduler's: `router_queue_full`
+when the router's own pending queue is at bound, `tenant_quota` when a
+tenant's outstanding requests hit `--tenant_quota`, `draining` after
+SIGTERM. A replica-side `queue_full` rejection is retried on the
+backoff schedule before it becomes the client's problem.
+
+The robustness core is JOURNAL-OWNERSHIP HANDOFF. When a replica dies
+mid-stream, its journal still holds everything needed to continue
+(accept-before-ack, token-before-emit — serving/journal.py): the
+router folds that journal (`handoff_states`), forwards any journaled-
+but-unsent tokens to the client, settles requests whose stream already
+finished, and re-dispatches the rest to a survivor as raw resume state
+(`prime_tokens` + fast-forwarded `key` over the wire) — bit-identical
+to the uninterrupted stream, and shape-identical to every other
+request, so survivors never recompile. Ownership is then marked: a
+`done(status="handed_off")` record in the dead journal means a restart
+with `--replay` skips the request — the router and the replay can
+never double-serve. Requests the dead replica never journaled were
+never acknowledged past the router, so a fresh re-dispatch is safe.
+
+Telemetry: each request is ONE async `req` track (queued → dispatched,
+with handoff/shed instants) — this module shares the raw-`req`-record
+privilege with serving/scheduler.py (PGL006). Routing decisions land
+as `{"ev": "route", "status": dispatched|handoff|shed|replica_down}`
+records (grammar owned HERE, linted by PGL006) — what `summarize`
+builds its per-replica router table from. Metrics render under the
+`progen_router_` Prometheus prefix.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import socket
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from progen_tpu.resilience.chaos import ChaosError, maybe_inject
+from progen_tpu.resilience.retry import RetryPolicy, policy_from_env
+from progen_tpu.serving.journal import (
+    STATUS_COMPLETED,
+    STATUS_HANDED_OFF,
+    RequestJournal,
+    handoff_states,
+    resume_request,
+)
+from progen_tpu.serving.metrics import ServingMetrics
+from progen_tpu.serving.scheduler import REJECT_DRAINING, REJECT_QUEUE_FULL
+from progen_tpu.telemetry.spans import get_telemetry
+
+# the route-record status alphabet (PGL006-enforced)
+ROUTE_DISPATCHED = "dispatched"
+ROUTE_HANDOFF = "handoff"
+ROUTE_SHED = "shed"
+ROUTE_REPLICA_DOWN = "replica_down"
+
+REJECT_NO_REPLICAS = "no_replicas"
+REJECT_ROUTER_QUEUE_FULL = "router_queue_full"
+REJECT_TENANT_QUOTA = "tenant_quota"
+# a replica died holding tokens we cannot re-derive (no journal)
+REJECT_REPLICA_LOST = "replica_lost"
+
+
+@dataclasses.dataclass
+class ReplicaSpec:
+    """One replica endpoint. ``journal_dir`` is what makes handoff
+    possible — without it a dead replica's mid-stream requests can only
+    be shed (the tokens the client saw cannot be re-derived)."""
+
+    socket_path: str
+    journal_dir: Optional[str] = None
+    prom_file: Optional[str] = None
+    name: Optional[str] = None
+
+
+def parse_replica_spec(text: str) -> ReplicaSpec:
+    """CLI form: ``sock=PATH[,journal=DIR][,prom=FILE][,name=N]``, or a
+    bare socket path."""
+    if "=" not in text:
+        return ReplicaSpec(socket_path=text)
+    kw: Dict[str, str] = {}
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        k, _, v = part.partition("=")
+        kw[k.strip()] = v.strip()
+    if "sock" not in kw:
+        raise ValueError(f"--replica spec needs sock=PATH: {text!r}")
+    extra = set(kw) - {"sock", "journal", "prom", "name"}
+    if extra:
+        raise ValueError(f"unknown --replica key(s) {sorted(extra)}")
+    return ReplicaSpec(
+        socket_path=kw["sock"], journal_dir=kw.get("journal"),
+        prom_file=kw.get("prom"), name=kw.get("name"),
+    )
+
+
+class CircuitBreaker:
+    """Per-replica failure gate with retry-policy backoff semantics:
+    consecutive failures open the circuit for an exponentially growing
+    seeded-jitter interval (`RetryPolicy.delay`), any success closes
+    it. The attempt index saturates at ``max_attempts - 1`` so a
+    long-dead replica keeps being probed at ``max_delay_s`` cadence —
+    a breaker that gives up permanently could never notice a replica
+    coming back."""
+
+    def __init__(self, label: str, policy: Optional[RetryPolicy] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.policy = policy if policy is not None else policy_from_env()
+        self._rng = random.Random(f"{self.policy.seed}:{label}")
+        self._clock = clock
+        self.failures = 0
+        self.open_until = 0.0
+
+    def record_failure(self) -> float:
+        attempt = min(self.failures, self.policy.max_attempts - 1)
+        delay = self.policy.delay(attempt, self._rng)
+        self.failures += 1
+        self.open_until = self._clock() + delay
+        return delay
+
+    def record_success(self) -> None:
+        self.failures = 0
+        self.open_until = 0.0
+
+    @property
+    def is_open(self) -> bool:
+        return self._clock() < self.open_until
+
+
+@dataclasses.dataclass
+class _InFlight:
+    """Router-side request state. ``wire`` is the id on the replica
+    wire (unique per router lifetime, so journals never fold two client
+    requests that happened to reuse an id); ``raw`` is the original
+    request object, ``resume`` replaces it after a handoff."""
+
+    wire: str
+    public: str
+    client: object
+    raw: dict
+    tenant: Optional[str]
+    t_submit: float
+    phase: str = "queued"  # "queued" | "dispatched" (req-track phase)
+    replica: Optional[int] = None
+    resume: Optional[dict] = None
+    retries: int = 0
+    not_before: float = 0.0
+    last_index: Optional[int] = None
+    n_tokens: int = 0
+    text: str = ""
+    first_token_t: Optional[float] = None
+
+
+class ReplicaLink:
+    """One replica's connection + router-visible state."""
+
+    def __init__(self, index: int, spec: ReplicaSpec,
+                 policy: Optional[RetryPolicy],
+                 clock: Callable[[], float]):
+        self.index = index
+        self.spec = spec
+        self.name = spec.name or f"replica{index}"
+        self.breaker = CircuitBreaker(self.name, policy, clock)
+        self.sock: Optional[socket.socket] = None
+        self.buf = b""
+        self.inflight: Dict[str, _InFlight] = {}
+        self.health: Dict[str, float] = {}
+        self.health_mtime: Optional[float] = None
+        self.health_rx: Optional[float] = None
+
+    @property
+    def up(self) -> bool:
+        return self.sock is not None
+
+    def journal_path(self) -> Optional[str]:
+        if self.spec.journal_dir is None:
+            return None
+        return os.path.join(self.spec.journal_dir, "journal.jsonl")
+
+    def connect(self) -> None:
+        maybe_inject("router/connect")
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.settimeout(2.0)
+        try:
+            s.connect(self.spec.socket_path)
+        except BaseException:
+            s.close()
+            raise
+        s.setblocking(False)
+        self.sock = s
+        self.buf = b""
+
+    def close(self) -> None:
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+        self.sock = None
+        self.buf = b""
+
+    def send(self, obj: dict) -> None:
+        assert self.sock is not None
+        data = (json.dumps(obj) + "\n").encode()
+        # request lines are small; a bounded blocking send keeps the
+        # loop simple (a replica that can't drain 4KB in 5s is down)
+        self.sock.settimeout(5.0)
+        try:
+            self.sock.sendall(data)
+        finally:
+            if self.sock is not None:
+                self.sock.setblocking(False)
+
+    def recv_events(self) -> Tuple[List[dict], bool]:
+        """Drain whatever the replica has written: (events, eof). A
+        SIGKILLed replica's socket reads EOF — the immediate down
+        signal the handoff rides on."""
+        if self.sock is None:
+            return [], False
+        eof = False
+        while True:
+            try:
+                data = self.sock.recv(65536)
+            except (BlockingIOError, InterruptedError):
+                break
+            except OSError:
+                data = b""
+            if not data:
+                eof = True
+                break
+            self.buf += data
+        *lines, self.buf = self.buf.split(b"\n")
+        events = []
+        for raw in lines:
+            if not raw.strip():
+                continue
+            try:
+                events.append(json.loads(raw.decode("utf-8", "replace")))
+            except ValueError:
+                continue  # a dying writer may tear its final line
+        return events, eof
+
+
+class Router:
+    """Single-threaded request router. The caller owns the loop:
+    ``submit()`` requests as they arrive, ``poll()`` every tick, write
+    out the (client, event) pairs it returns. Same ownership shape as
+    Scheduler — no threads, no locks, deterministic under test."""
+
+    def __init__(self, specs: List[ReplicaSpec], *, max_queue: int = 256,
+                 tenant_quota: int = 0,
+                 policy: Optional[RetryPolicy] = None,
+                 metrics: Optional[ServingMetrics] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 heartbeat_timeout: float = 30.0,
+                 health_every: float = 2.0,
+                 max_redispatch: int = 3):
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.policy = policy if policy is not None else policy_from_env()
+        self._clock = clock
+        self.links = [
+            ReplicaLink(i, s, self.policy, clock)
+            for i, s in enumerate(specs)
+        ]
+        self.max_queue = int(max_queue)
+        self.tenant_quota = int(tenant_quota)
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        self.heartbeat_timeout = float(heartbeat_timeout)
+        self.health_every = float(health_every)
+        self.max_redispatch = int(max_redispatch)
+        self.pending: deque[_InFlight] = deque()
+        self.by_wire: Dict[str, _InFlight] = {}
+        self.draining = False
+        self._seq = 0
+        self._out: List[Tuple[object, dict]] = []
+        self._rng = random.Random(f"{self.policy.seed}:router")
+        self._tenants: Dict[str, int] = {}
+        self._last_health = -1e9
+        for fam in ("ttft_s", "latency_s"):
+            self.metrics.declare_timing(fam)
+
+    # ----- telemetry -------------------------------------------------------
+
+    def _req_event(self, ph: str, rid: str, name: str,
+                   ts: Optional[float] = None, **attrs) -> None:
+        rec = {
+            "ev": "req", "ph": ph, "name": name, "req": rid,
+            "ts": time.time() if ts is None else ts,
+        }
+        if attrs:
+            rec.update(attrs)
+        get_telemetry().emit(rec)
+
+    def _route(self, status: str, **attrs) -> None:
+        """One routing-decision record; None attrs are omitted."""
+        rec = {"ev": "route", "ts": time.time(), "status": status}
+        rec.update({k: v for k, v in attrs.items() if v is not None})
+        get_telemetry().emit(rec)
+
+    def close_tracks(self, reason: str = "killed") -> None:
+        """Crash-path teardown: close every open req track so a ``b``
+        without its ``e`` still means 'died mid-phase' (the scheduler's
+        contract, kept across the fleet)."""
+        now = time.time()
+        for inf in list(self.by_wire.values()):
+            self._req_event("n", inf.wire, reason, ts=now)
+            self._req_event("e", inf.wire, inf.phase, ts=now)
+            self._req_event("e", inf.wire, "request", ts=now,
+                            reason=reason)
+
+    # ----- intake ----------------------------------------------------------
+
+    def submit(self, obj: dict, client: object = None) -> Optional[dict]:
+        """Admit one request (parsed JSON object; ``id`` required).
+        Returns a rejection event to answer immediately, or None on
+        acceptance — tokens/done then stream via ``poll()``."""
+        self.metrics.inc("requests_submitted")
+        public = obj.get("id")
+        if public is None:
+            self.metrics.inc("requests_rejected")
+            return {"event": "rejected", "id": None,
+                    "reason": "bad request line: missing id"}
+        public = str(public)
+
+        def reject(reason: str) -> dict:
+            self.metrics.inc("requests_rejected")
+            self.metrics.inc(f"rejected_{reason}")
+            self._route(ROUTE_SHED, req=public, reason=reason)
+            return {"event": "rejected", "id": public, "reason": reason}
+
+        if self.draining:
+            return reject(REJECT_DRAINING)
+        if not self.links:
+            return reject(REJECT_NO_REPLICAS)
+        if len(self.pending) >= self.max_queue:
+            return reject(REJECT_ROUTER_QUEUE_FULL)
+        tenant = obj.get("tenant")
+        tenant = None if tenant is None else str(tenant)
+        if (
+            self.tenant_quota > 0
+            and tenant is not None
+            and self._tenants.get(tenant, 0) >= self.tenant_quota
+        ):
+            return reject(REJECT_TENANT_QUOTA)
+        # wire ids are unique per router lifetime: a client reusing an
+        # id after settlement must not fold with the old request in any
+        # replica journal
+        self._seq += 1
+        wire = f"q{self._seq}-{public}"
+        inf = _InFlight(
+            wire=wire, public=public, client=client,
+            raw={**obj, "id": wire}, tenant=tenant,
+            t_submit=self._clock(),
+        )
+        if tenant is not None:
+            self._tenants[tenant] = self._tenants.get(tenant, 0) + 1
+        self.pending.append(inf)
+        self.by_wire[wire] = inf
+        now = time.time()
+        self._req_event("b", wire, "request", ts=now, id=public)
+        self._req_event("b", wire, "queued", ts=now)
+        self.metrics.set_gauge("queue_depth", len(self.pending))
+        return None
+
+    # ----- the loop --------------------------------------------------------
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.pending) or any(
+            link.inflight for link in self.links
+        )
+
+    def fds(self) -> List[socket.socket]:
+        """Live replica sockets, for the caller's select()."""
+        return [link.sock for link in self.links if link.sock is not None]
+
+    def poll(self) -> List[Tuple[object, dict]]:
+        """One router tick: maintain connections, read replica events
+        (handing off on a death), dispatch pending work, scrape health.
+        Returns (client, event) pairs to deliver."""
+        now = self._clock()
+        for link in self.links:
+            if not link.up and not link.breaker.is_open:
+                self._try_connect(link, now)
+        for link in self.links:
+            if not link.up:
+                continue
+            events, eof = link.recv_events()
+            for ev in events:
+                self._on_replica_event(link, ev, now)
+            if eof:
+                self._replica_down(link, "connection_eof", now)
+        self._dispatch_pending(now)
+        self._scrape_health(now)
+        self.metrics.set_gauge(
+            "replicas_up", sum(1 for link in self.links if link.up)
+        )
+        self.metrics.set_gauge("queue_depth", len(self.pending))
+        self.metrics.set_gauge(
+            "inflight", sum(len(link.inflight) for link in self.links)
+        )
+        out, self._out = self._out, []
+        return out
+
+    def drain(self, reason: str = REJECT_DRAINING) -> int:
+        """Graceful-shutdown intake cut: shed every queued request now;
+        in-flight streams (and any handoffs their replicas' deaths
+        require) run to completion. The caller keeps polling until
+        ``has_work`` is False."""
+        self.draining = True
+        n = 0
+        now = self._clock()
+        while self.pending:
+            self._shed(self.pending.popleft(), reason, now)
+            n += 1
+        self.metrics.set_gauge("queue_depth", 0)
+        return n
+
+    # ----- connections & health -------------------------------------------
+
+    def _try_connect(self, link: ReplicaLink, now: float) -> bool:
+        try:
+            link.connect()
+        except (ChaosError, OSError):
+            link.breaker.record_failure()
+            self.metrics.inc("connect_failures")
+            return False
+        link.breaker.record_success()
+        return True
+
+    def _scrape_health(self, now: float) -> None:
+        if now - self._last_health < self.health_every:
+            return
+        self._last_health = now
+        for link in self.links:
+            pf = link.spec.prom_file
+            if not pf:
+                continue
+            try:
+                mtime = os.stat(pf).st_mtime
+                if mtime == link.health_mtime:
+                    continue
+                with open(pf) as f:
+                    link.health = _parse_prom(f.read())
+            except (OSError, ValueError):
+                continue
+            link.health_mtime = mtime
+            # the prom rewrite cadence IS the heartbeat
+            link.health_rx = now
+
+    def _stale(self, link: ReplicaLink, now: float) -> bool:
+        if link.spec.prom_file is None or link.health_rx is None:
+            return False
+        return (now - link.health_rx) > self.heartbeat_timeout
+
+    # ----- dispatch --------------------------------------------------------
+
+    def _pick_replica(self, now: float) -> Optional[ReplicaLink]:
+        """Least-loaded UP replica: router-tracked in-flight plus the
+        replica's own scraped queue depth; stale-heartbeat replicas are
+        deprioritized; lowest index breaks ties (deterministic)."""
+        best = None
+        best_key = None
+        for link in self.links:
+            if not link.up:
+                continue
+            load = len(link.inflight) + int(
+                link.health.get("queue_depth", 0)
+            )
+            key = (1 if self._stale(link, now) else 0, load, link.index)
+            if best_key is None or key < best_key:
+                best, best_key = link, key
+        return best
+
+    def _dispatch_pending(self, now: float) -> None:
+        if not self.pending:
+            return
+        keep: deque[_InFlight] = deque()
+        while self.pending:
+            inf = self.pending.popleft()
+            if inf.not_before > now:
+                keep.append(inf)
+                continue
+            link = self._pick_replica(now)
+            if link is None:
+                # nobody can take anything this tick
+                keep.append(inf)
+                keep.extend(self.pending)
+                self.pending.clear()
+                break
+            if not self._send_to(link, inf, now):
+                keep.append(inf)
+        self.pending = keep
+        self.metrics.set_gauge("queue_depth", len(self.pending))
+
+    def _send_to(self, link: ReplicaLink, inf: _InFlight,
+                 now: float) -> bool:
+        payload = inf.resume if inf.resume is not None else inf.raw
+        try:
+            # chaos site (PROGEN_CHAOS="router/dispatch:fail@N"): the
+            # dispatch path has no span of its own (per-request span
+            # records would swamp the trace), so the injector is called
+            # directly, like serve/decode
+            maybe_inject("router/dispatch")
+            link.send(payload)
+        except ChaosError:
+            # transient: back off and re-route (possibly elsewhere)
+            inf.retries += 1
+            inf.not_before = now + self.policy.delay(
+                min(inf.retries - 1, self.policy.max_attempts - 1),
+                self._rng,
+            )
+            self.metrics.inc("redispatch_retries")
+            return False
+        except OSError:
+            self._replica_down(link, "send_failed", now)
+            return False
+        link.inflight[inf.wire] = inf
+        inf.replica = link.index
+        inf.not_before = 0.0
+        ts = time.time()
+        if inf.phase == "queued":
+            self._req_event("e", inf.wire, "queued", ts=ts)
+        self._req_event("b", inf.wire, "dispatched", ts=ts,
+                        replica=link.index)
+        inf.phase = "dispatched"
+        self.metrics.inc("dispatched_total")
+        self._route(
+            ROUTE_DISPATCHED, req=inf.public, replica=link.index,
+            retry=inf.retries or None,
+            resumed=True if inf.resume is not None else None,
+        )
+        return True
+
+    def _requeue(self, inf: _InFlight, now: float, backoff: bool = False,
+                 front: bool = False) -> None:
+        inf.replica = None
+        if backoff:
+            inf.retries += 1
+            inf.not_before = now + self.policy.delay(
+                min(inf.retries - 1, self.policy.max_attempts - 1),
+                self._rng,
+            )
+            self.metrics.inc("redispatch_retries")
+        if inf.phase == "dispatched":
+            ts = time.time()
+            self._req_event("e", inf.wire, "dispatched", ts=ts)
+            self._req_event("b", inf.wire, "queued", ts=ts)
+        inf.phase = "queued"
+        if front:
+            self.pending.appendleft(inf)
+        else:
+            self.pending.append(inf)
+
+    # ----- replica events --------------------------------------------------
+
+    def _on_replica_event(self, link: ReplicaLink, ev: dict,
+                          now: float) -> None:
+        inf = link.inflight.get(ev.get("id"))
+        if inf is None:
+            return  # an id we no longer own (settled via handoff)
+        kind = ev.get("event")
+        if kind == "token":
+            self._forward_token(inf, ev)
+        elif kind == "done":
+            link.inflight.pop(inf.wire, None)
+            self._settle(inf, now)
+        elif kind == "rejected":
+            link.inflight.pop(inf.wire, None)
+            reason = str(ev.get("reason", "rejected"))
+            if (
+                reason == REJECT_QUEUE_FULL
+                and inf.retries < self.max_redispatch
+            ):
+                self._requeue(inf, now, backoff=True)
+            else:
+                self._shed(inf, reason, now, replica=link.index)
+
+    def _forward_token(self, inf: _InFlight, ev: dict) -> None:
+        index = int(ev.get("index", -1))
+        if inf.last_index is not None and index <= inf.last_index:
+            return  # journal gap-fill already delivered it
+        if inf.first_token_t is None:
+            inf.first_token_t = self._clock()
+            self.metrics.observe("ttft_s", inf.first_token_t - inf.t_submit)
+            self._req_event("n", inf.wire, "first_token")
+        inf.last_index = index
+        inf.n_tokens += 1
+        inf.text += str(ev.get("text", ""))
+        self.metrics.inc("tokens_forwarded")
+        self._out.append((inf.client, {**ev, "id": inf.public}))
+
+    def _settle(self, inf: _InFlight, now: float,
+                replayed: bool = False) -> None:
+        """Request finished: answer the client from the ROUTER's
+        accounting (the replica's done only covers its own life; after
+        a handoff the full text spans lives)."""
+        self.by_wire.pop(inf.wire, None)
+        self._tenant_release(inf)
+        self.metrics.inc("requests_completed")
+        latency = now - inf.t_submit
+        self.metrics.observe("latency_s", latency)
+        ts = time.time()
+        self._req_event("e", inf.wire, inf.phase, ts=ts)
+        self._req_event("e", inf.wire, "request", ts=ts,
+                        n_generated=inf.n_tokens)
+        ev = {
+            "event": "done", "id": inf.public, "text": inf.text,
+            "n_generated": inf.n_tokens,
+            "ttft_s": round((inf.first_token_t or now) - inf.t_submit, 6),
+            "latency_s": round(latency, 6),
+        }
+        if replayed:
+            ev["replayed"] = True
+        self._out.append((inf.client, ev))
+
+    def _shed(self, inf: _InFlight, reason: str, now: float,
+              replica: Optional[int] = None) -> None:
+        self.by_wire.pop(inf.wire, None)
+        self._tenant_release(inf)
+        self.metrics.inc("requests_rejected")
+        head = reason.split(":")[0].strip().replace(" ", "_")
+        self.metrics.inc(f"rejected_{head}")
+        ts = time.time()
+        self._req_event("n", inf.wire, "shed", ts=ts, reason=reason)
+        self._req_event("e", inf.wire, inf.phase, ts=ts)
+        self._req_event("e", inf.wire, "request", ts=ts, reason=reason)
+        self._route(ROUTE_SHED, req=inf.public, reason=reason,
+                    replica=replica)
+        self._out.append((inf.client, {
+            "event": "rejected", "id": inf.public, "reason": reason,
+        }))
+
+    def _tenant_release(self, inf: _InFlight) -> None:
+        if inf.tenant is None:
+            return
+        left = self._tenants.get(inf.tenant, 1) - 1
+        if left <= 0:
+            self._tenants.pop(inf.tenant, None)
+        else:
+            self._tenants[inf.tenant] = left
+
+    # ----- journal-ownership handoff ---------------------------------------
+
+    def _replica_down(self, link: ReplicaLink, why: str,
+                      now: float) -> None:
+        link.close()
+        link.breaker.record_failure()
+        inflight = list(link.inflight.values())
+        link.inflight.clear()
+        self.metrics.inc("replica_down_total")
+        self._route(ROUTE_REPLICA_DOWN, replica=link.index, reason=why,
+                    in_flight=len(inflight))
+        if inflight:
+            self._handoff(link, inflight, now)
+
+    def _handoff(self, link: ReplicaLink, inflight: List[_InFlight],
+                 now: float) -> None:
+        from progen_tpu import telemetry
+
+        def body() -> None:
+            jpath = link.journal_path()
+            states: dict = {}
+            if jpath is not None and os.path.exists(jpath):
+                states = handoff_states(jpath)
+            marker = (
+                RequestJournal(jpath)
+                if jpath is not None and states else None
+            )
+            try:
+                for inf in inflight:
+                    self._handoff_one(
+                        link, inf, states.get(inf.wire), marker, now
+                    )
+            finally:
+                if marker is not None:
+                    marker.close()
+
+        try:
+            with telemetry.span("router/handoff", replica=link.index,
+                                in_flight=len(inflight)):
+                body()
+        except ChaosError:
+            # the chaos site fires at span entry; an injected transient
+            # fault must not lose the fleet's in-flight work — re-read
+            # and re-run (the journal fold is idempotent; nothing was
+            # marked or dispatched before the span opened)
+            self.metrics.inc("handoff_chaos_retries")
+            body()
+
+    def _handoff_one(self, link: ReplicaLink, inf: _InFlight,
+                     cls: Optional[dict], marker: Optional[RequestJournal],
+                     now: float) -> None:
+        self.metrics.inc("handoffs_total")
+        if cls is None or cls.get("accept") is None:
+            # never journaled: accept-before-ack ordering means the dead
+            # replica never emitted a token for it, so a fresh
+            # re-dispatch cannot duplicate anything. Without a journal
+            # that proof only holds for requests that streamed nothing.
+            if link.journal_path() is None and inf.n_tokens > 0:
+                self._shed(inf, REJECT_REPLICA_LOST, now,
+                           replica=link.index)
+                return
+            self._route(ROUTE_HANDOFF, req=inf.public, resumed=False,
+                        **{"from": link.index})
+            self._requeue(inf, now, front=True)
+            return
+        # forward journaled-but-unsent tokens: written before the
+        # replica could emit them, so the client has never seen them
+        from progen_tpu.data.tokenizer import decode_tokens
+
+        start = cls["start"]
+        for k, tok in enumerate(cls["emitted"]):
+            self._forward_token(inf, {
+                "event": "token", "id": inf.wire, "token": int(tok),
+                "text": decode_tokens([int(tok)]), "index": start + k,
+            })
+        if cls["kind"] in ("done", "finished"):
+            # the journaled stream is already complete — settle with the
+            # client now; 'finished' gets its terminal record so a
+            # replay of this journal skips it too
+            if marker is not None and cls["kind"] == "finished":
+                for jid in cls["jids"]:
+                    marker.done(jid, STATUS_COMPLETED, len(cls["emitted"]))
+            link.inflight.pop(inf.wire, None)
+            self.metrics.inc("handoff_settled")
+            self._route(ROUTE_HANDOFF, req=inf.public, resumed=False,
+                        settled=True, **{"from": link.index})
+            self._settle(inf, now, replayed=True)
+            return
+        # mid-stream: fold watermarks into resume state exactly as
+        # --replay does, and re-route to a survivor
+        req = resume_request(inf.wire, cls)
+        import numpy as np
+
+        inf.resume = {
+            "id": inf.wire,
+            "prime_tokens": [int(t) for t in np.asarray(req.prime).reshape(-1)],
+            "length": int(req.length),
+            "top_k": None if req.top_k is None else int(req.top_k),
+            "add_bos": bool(req.add_bos),
+            "temperature": float(req.temperature),
+            "top_p": None if req.top_p is None else float(req.top_p),
+            "key": [int(k) for k in np.asarray(req.key).reshape(-1)],
+        }
+        target = self._pick_replica(now)
+        sent = target is not None and self._send_to(target, inf, now)
+        if not sent:
+            self._requeue(inf, now, front=True)
+        # ownership mark AFTER the re-dispatch attempt: from this record
+        # on the request is the router's (a restart of the dead replica
+        # with --replay must skip it), whether it is already streaming
+        # on a survivor or waiting in the router's queue
+        if marker is not None:
+            for jid in cls["jids"]:
+                marker.done(jid, STATUS_HANDED_OFF, len(cls["emitted"]))
+        self.metrics.inc("handoff_resumed")
+        self._route(
+            ROUTE_HANDOFF, req=inf.public, resumed=True,
+            to=target.index if sent else None, **{"from": link.index},
+        )
+
+
+def _parse_prom(text: str) -> Dict[str, float]:
+    """Minimal Prometheus text parse: bare `name value` samples, keys
+    stripped of the progen_serve_ prefix. Labeled samples (quantiles)
+    are kept under their full labeled name — the router only reads the
+    bare gauges (queue_depth, active_slots, decode_compile_count)."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name, _, val = line.rpartition(" ")
+        if not name:
+            continue
+        if name.startswith("progen_serve_"):
+            name = name[len("progen_serve_"):]
+        try:
+            out[name] = float(val)
+        except ValueError:
+            continue
+    return out

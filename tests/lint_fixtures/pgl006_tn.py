@@ -40,3 +40,11 @@ def clean_reload_metrics(reg):
 def clean_replay_instant(emit):
     # journal_replay is a plain instant, not a journal record
     emit({"ev": "journal_replay", "ts": 1.0, "resumed": 3})
+
+
+def clean_router_metrics(reg):
+    # router METRICS are fine anywhere — only raw route records are
+    # restricted to serving/router.py
+    reg.inc("handoff_resumed")
+    reg.set_gauge("replicas_up", 2)
+    reg.observe("latency_s", 0.2)

@@ -1,4 +1,4 @@
-"""PGL006 true positives: telemetry hygiene. Expected findings: 11."""
+"""PGL006 true positives: telemetry hygiene. Expected findings: 14."""
 
 
 def unbounded_span(telemetry, name):
@@ -39,3 +39,14 @@ def bad_reload_status(emit):
     # TP x2: reload record outside serving/reload.py AND a status the
     # zero-downtime smoke can't classify
     emit({"ev": "reload", "status": "half_done"})
+
+
+def raw_route_record(emit):
+    # TP: route record outside serving/router.py
+    emit({"ev": "route", "status": "dispatched", "replica": 0})
+
+
+def bad_route_status(emit):
+    # TP x2: outside serving/router.py AND a status outside the
+    # dispatched/handoff/shed/replica_down routing alphabet
+    emit({"ev": "route", "status": "rerouted", "replica": 1})

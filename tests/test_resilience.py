@@ -282,6 +282,97 @@ class TestLossSentinel:
         assert anomaly.consistent_flag(False) is False
 
 
+class TestPoisonBisector:
+    def _simulate(self, window, min_step, poison_at):
+        """Drive the train-loop protocol against a synthetic poisoned
+        stream: a resume at ``skip`` re-spikes iff ``skip <= poison_at``
+        (the poison record is still ahead of the resume point). Returns
+        (final_skip, probes)."""
+        b = anomaly.PoisonBisector(window, min_step=min_step)
+        probes = 0
+        while True:
+            skip = b.propose()
+            probes += 1
+            assert 0 < skip <= window
+            if skip > poison_at:
+                return skip, probes
+            b.observe_respike()
+            assert probes <= window + 1, "bisection did not converge"
+
+    def test_salvages_tail_when_poison_is_early(self):
+        # poison in record 1 of a 16-wide window: one probe (skip 8)
+        # clears it and 8 sequences are salvaged vs the legacy discard
+        skip, probes = self._simulate(16, 2, poison_at=1)
+        assert skip == 8 and probes == 1
+
+    def test_converges_on_late_poison(self):
+        # poison at the end: every probe re-spikes until the full
+        # window is skipped — never worse than the legacy behavior
+        skip, probes = self._simulate(16, 2, poison_at=15)
+        assert skip == 16
+        assert probes <= 5  # logarithmic, not linear
+
+    def test_skips_align_to_min_step_except_terminal(self):
+        b = anomaly.PoisonBisector(12, min_step=4)
+        seen = []
+        while not b.exhausted:
+            s = b.propose()
+            seen.append(s)
+            b.observe_respike()
+        assert all(s % 4 == 0 for s in seen[:-1])
+        assert b.propose() == 12  # exhausted -> whole window
+
+    def test_window_of_one_step_degrades_to_legacy(self):
+        # effective_batch == batch_size: no room to bisect; the first
+        # proposal IS the legacy whole-window skip
+        b = anomaly.PoisonBisector(8, min_step=8)
+        assert b.exhausted
+        assert b.propose() == 8
+        assert b.salvaged == 0
+
+    def test_salvaged_counts_the_kept_tail(self):
+        b = anomaly.PoisonBisector(16, min_step=2)
+        assert b.propose() == 8
+        assert b.salvaged == 8
+
+    def test_synthetic_poisoned_stream_end_to_end(self):
+        """Sentinel + bisector on a synthetic stream: losses are clean,
+        a poison record spikes them, rollback bisects, and the salvage
+        is real — fewer sequences discarded than the whole window."""
+        rng = random.Random(1)
+        window = 32
+        poison_at = 5  # poison early in the window
+        sentinel = anomaly.LossSentinel(factor=6.0, patience=2, warmup=5)
+        for _ in range(12):
+            assert sentinel.observe(
+                2.0 + 0.05 * rng.random()
+            ) == anomaly.OK
+
+        def stream_spikes(resume_skip):
+            # after resuming at resume_skip, does the window re-spike?
+            return resume_skip <= poison_at
+
+        # first anomaly -> rollback after `patience` consecutive spikes
+        assert sentinel.observe(1e9) == anomaly.SPIKE
+        assert sentinel.observe(1e9) == anomaly.ROLLBACK
+        b = anomaly.PoisonBisector(window, min_step=4)
+        sentinel.reset()
+        probes = 0
+        while True:
+            skip = b.propose()
+            probes += 1
+            if not stream_spikes(skip):
+                break
+            b.observe_respike()
+        assert skip < window  # salvaged SOMETHING
+        assert b.salvaged == window - skip
+        assert probes <= 4
+
+    def test_rejects_bad_window(self):
+        with pytest.raises(ValueError):
+            anomaly.PoisonBisector(0)
+
+
 # --------------------------------------------------- watchdog escalation
 
 
