@@ -42,6 +42,11 @@ dashboard query then matches nothing. Three checks:
     ``serving/reload.py``, and a literal ``"status"`` must be one of
     ``staged``/``committed``/``rejected`` — the zero-downtime smoke in
     CI greps these to assert a reload fully applied or fully didn't.
+  * raw ``"ev": "score"`` records must not be emitted outside
+    ``progen_tpu/workloads/``, and a literal ``"op"`` must be one of
+    ``start``/``resume``/``batch``/``skip``/``done`` — the batch-score
+    journal's grammar is the resume/progress contract the CI workloads
+    smoke (and ``summarize``) read.
 """
 
 from __future__ import annotations
@@ -204,6 +209,22 @@ class TelemetryHygieneRule(Rule):
                     "reload record 'status'",
                     "anything else reads as a torn reload to the "
                     "zero-downtime tooling",
+                )
+            elif v.value == "score":
+                if "/workloads/" not in self.ctx.path.replace("\\", "/"):
+                    self.report(
+                        v,
+                        "raw score record emitted outside "
+                        "progen_tpu/workloads/ — the batch-score "
+                        "journal's op grammar is the resume/progress "
+                        "contract the CI workloads smoke greps; go "
+                        "through ScoreJournal, not hand-rolled records",
+                    )
+                self._check_literal_member(
+                    d, "op", ("start", "resume", "batch", "skip", "done"),
+                    "score record 'op'",
+                    "an unknown op is invisible to the scoring progress "
+                    "tooling and the resume smoke",
                 )
             elif not _PROM_NAME_RE.match(v.value):
                 self.report(

@@ -36,7 +36,7 @@ def main(checkpoint_path, data_path, split, batch_size):
     from progen_tpu.config import ProGenConfig
     from progen_tpu.data.dataset import iterator_from_tfrecords_folder
     from progen_tpu.models.progen import ProGen
-    from progen_tpu.training.loss import cross_entropy
+    from progen_tpu.training.loss import sequence_scores
 
     _, get_last, _ = get_checkpoint_fns(checkpoint_path)
     pkg = get_last.restore_params()  # params only: no optimizer moments
@@ -53,8 +53,10 @@ def main(checkpoint_path, data_path, split, batch_size):
     @jax.jit
     def per_seq_loss(params, data):
         ids, labels = data[..., :-1], data[..., 1:]
+        # the shared scorer (training/loss.py): eval and the batch-score
+        # workload reduce the same per-token logprobs, bit-for-bit
         logits = model.apply({"params": params}, ids)
-        return cross_entropy(logits, labels)  # (batch,)
+        return sequence_scores(logits, labels)[0]  # (batch,)
 
     losses = []
     # loop=False walks the split exactly once; the final ragged batch is

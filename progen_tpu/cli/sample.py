@@ -7,6 +7,11 @@ after the prime is printed. Prime conventions (README.md:82-86):
 ``"[tax=Mammalia] #"`` generates a sequence; ``"SEQ #"`` generates
 annotations.
 
+Fixed-position infilling (progen_tpu/workloads/infill.py): ``--template
+"MK?LV??G"`` keeps the non-``?`` characters verbatim and samples the
+free slots; the leading frozen run primes the decode, so --prime and
+--template are mutually exclusive.
+
 Run: python -m progen_tpu.cli.sample --prime "[tax=Mammalia] #"
 """
 
@@ -48,8 +53,14 @@ import jax
     help="decode this many sequences from the prime in one batched "
     "KV-cache pass (--naive switches to the full-forward batched decode)",
 )
+@click.option("--template", default=None, type=str,
+              help="infilling template: non-free characters are frozen "
+                   "verbatim, --free_char slots are sampled (replaces "
+                   "--prime; the frozen prefix primes the decode)")
+@click.option("--free_char", default="?",
+              help="the free-position sentinel inside --template")
 def main(seed, checkpoint_path, prime, top_k, temperature, top_p,
-         naive, num_samples):
+         naive, num_samples, template, free_char):
     from progen_tpu.checkpoint import get_checkpoint_fns
     from progen_tpu.config import ProGenConfig
     from progen_tpu.data.tokenizer import decode_tokens, encode_tokens
@@ -76,7 +87,26 @@ def main(seed, checkpoint_path, prime, top_k, temperature, top_p,
     print(f"sequence length: {config.seq_len}")
     print(f"trained for {max(pkg.next_seq_index, 0):,} sequences")
 
-    prime_tokens = np.asarray(encode_tokens(prime), dtype=np.int32)
+    length = config.seq_len
+    tpl_arr = frz_arr = None
+    if template is not None:
+        from progen_tpu.workloads.infill import (
+            infill_request_arrays,
+            parse_template,
+        )
+
+        if prime:
+            sys.exit("--template and --prime are mutually exclusive "
+                     "(the template's frozen prefix is the prime)")
+        if num_samples > 1:
+            sys.exit("--template decodes one sequence (--num_samples 1)")
+        toks, frz = parse_template(template, free_char)
+        prime_tokens, length, tpl_arr, frz_arr = infill_request_arrays(
+            toks, frz, add_bos=True
+        )
+        prime = decode_tokens(prime_tokens)
+    else:
+        prime_tokens = np.asarray(encode_tokens(prime), dtype=np.int32)
     prime_length = len(prime_tokens) + 1  # +1 for BOS (sample.py:67)
 
     if num_samples > 1:
@@ -98,11 +128,13 @@ def main(seed, checkpoint_path, prime, top_k, temperature, top_p,
         model,
         params,
         prime_tokens,
-        config.seq_len,
+        length,
         top_k=top_k,
         add_bos=True,
         temperature=temperature,
         top_p=top_p,
+        template=tpl_arr,
+        frozen=frz_arr,
     )
     sampled_str = decode_tokens(np.asarray(sampled)[prime_length:])
     print("\n", prime, "\n", "*" * 40, "\n", sampled_str)

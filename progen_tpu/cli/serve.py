@@ -11,13 +11,29 @@ defaults to --max-len.) The router's resume wire (serving/router.py)
 uses three extra optional fields: ``prime_tokens`` (raw token ids,
 bypassing the tokenizer), ``key`` (explicit uint32 PRNG key pair) and
 ``add_bos`` (default true) — together they let a handed-off request
-continue bit-identically on another replica. Responses stream back as
-JSON lines, one per
+continue bit-identically on another replica.
+
+Two protein-design request shapes ride the same wire
+(progen_tpu/workloads/):
+
+    {"id": "f1", "template": "MK?LV??G", "free_char": "?", ...}
+    {"id": "e1", "prime": "[tax=Mammalia] # MKLV", "embed": true}
+
+``template`` is fixed-position infilling: frozen characters are kept
+verbatim, ``free_char`` slots (default "?") are sampled; the leading
+frozen run becomes the prime and ``length`` is the template's, so both
+are derived, not read. (The resume wire may instead carry buffer-
+aligned ``template_tokens`` + ``frozen`` lists.) ``embed: true`` asks
+for a mean-pooled final-norm embedding of the prime instead of
+generation — the reply is a single terminal ``embedding`` event.
+Responses stream back as JSON lines, one per
 event, interleaved across requests as the engine produces them:
 
     {"event": "token", "id": "r1", "token": 77, "text": "L", "index": 18}
     {"event": "done", "id": "r1", "text": "...", "n_generated": 238,
      "ttft_s": 0.01, "latency_s": 0.9}
+    {"event": "embedding", "id": "e1", "dim": 1024, "values": [...],
+     "latency_s": 0.02}
     {"event": "rejected", "id": "r9", "reason": "queue_full"}
 
 Two transports, same protocol:
@@ -89,15 +105,44 @@ def _parse_request(line, defaults):
             key = jnp.asarray(
                 [int(k) for k in obj["key"]], dtype=jnp.uint32
             )
+        add_bos = bool(obj.get("add_bos", True))
+        length = int(obj.get("length", defaults["length"]))
+        template = frozen = None
+        if obj.get("template") is not None:
+            # infilling: the template fixes prime AND length — frozen
+            # prefix is the prime, template width is the length
+            from progen_tpu.workloads.infill import (
+                infill_request_arrays,
+                parse_template,
+            )
+
+            toks, frz = parse_template(
+                str(obj["template"]), str(obj.get("free_char", "?"))
+            )
+            prime, length, template, frozen = infill_request_arrays(
+                toks, frz, add_bos=add_bos
+            )
+        elif obj.get("template_tokens") is not None:
+            # resume wire: buffer-aligned constraint arrays as journaled
+            # (prime/length/add_bos already carried by their own fields)
+            template = np.asarray(
+                [int(t) for t in obj["template_tokens"]], dtype=np.int32
+            )
+            frozen = np.asarray(
+                [bool(f) for f in obj.get("frozen", [])], dtype=bool
+            )
         req = Request(
             id=rid,
             prime=prime,
-            length=int(obj.get("length", defaults["length"])),
+            length=length,
+            kind="embed" if obj.get("embed") else "generate",
+            template=template,
+            frozen=frozen,
             top_k=(None if obj.get("top_k", defaults["top_k"]) is None
                    else int(obj.get("top_k", defaults["top_k"]))),
             # default True: server parity with cli/sample.py; resumed
             # requests carry their journaled add_bos explicitly
-            add_bos=bool(obj.get("add_bos", True)),
+            add_bos=add_bos,
             temperature=float(
                 obj.get("temperature", defaults["temperature"])
             ),
@@ -134,6 +179,17 @@ def _events_to_lines(events, completions, starts):
         }))
     for c in completions:
         start = starts.pop(c.request_id, 0)
+        if getattr(c, "embedding", None) is not None:
+            # embed requests terminate with the vector, not a done line
+            vec = c.embedding
+            lines.append(json.dumps({
+                "event": "embedding",
+                "id": c.request_id,
+                "dim": int(vec.shape[0]),
+                "values": [round(float(x), 6) for x in vec],
+                "latency_s": round(c.latency_s, 6),
+            }))
+            continue
         lines.append(json.dumps({
             "event": "done",
             "id": c.request_id,

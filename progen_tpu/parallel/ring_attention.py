@@ -36,6 +36,7 @@ from progen_tpu.parallel.partition import shard_map
 # process: ring_local_attention is traced once per layer per compile,
 # and the evidence record only needs to exist, not repeat
 _CHECK_VMA_SEEN: set = set()
+_LAST_EVENTS: list = []
 
 
 def _record_check_vma(*, use_pallas: bool, interpret: bool,
@@ -49,16 +50,55 @@ def _record_check_vma(*, use_pallas: bool, interpret: bool,
     if config in _CHECK_VMA_SEEN:
         return
     _CHECK_VMA_SEEN.add(config)
-    from progen_tpu.telemetry import get_telemetry
-
-    get_telemetry().emit({
+    event = {
         "ev": "ring_check_vma",
         "backend": backend,
         "use_pallas": bool(use_pallas),
         "interpret": bool(interpret),
         "check_vma": bool(check_vma),
         "override": override,
-    })
+    }
+    _LAST_EVENTS.append(event)
+    from progen_tpu.telemetry import get_telemetry
+
+    get_telemetry().emit(event)
+
+
+def ring_vma_events() -> list:
+    """The ring_check_vma evidence records emitted so far this process
+    (one per distinct configuration) — bench/dryrun read these to carry
+    the compiled-path check_vma outcome into their result JSON."""
+    return list(_LAST_EVENTS)
+
+
+def record_ring_vma_policy(event: dict, path=None) -> None:
+    """Persist one ring_check_vma evidence record into the policy table
+    (ops/pallas_policy.json), keyed (backend, use_pallas, interpret) so a
+    re-run replaces its own configuration and never duplicates. This is
+    ADVICE r5's durable half: the compiled-TPU check_vma outcome survives
+    the process so a later CPU session can read what the chip accepted."""
+    import json as _json
+
+    from progen_tpu.ops.pallas_attention import _POLICY_PATH
+
+    path = path or _POLICY_PATH
+    try:
+        doc = _json.loads(path.read_text())
+        assert isinstance(doc, dict)
+    except (OSError, ValueError, AssertionError):
+        doc = {"schema": "pallas-policy-v1", "entries": []}
+    key = lambda e: (e.get("backend"), e.get("use_pallas"),
+                     e.get("interpret"))
+    kept = [
+        e for e in doc.get("ring_check_vma", [])
+        if isinstance(e, dict) and key(e) != key(event)
+    ]
+    doc["ring_check_vma"] = sorted(
+        kept + [dict(event)], key=lambda e: _json.dumps(key(e))
+    )
+    tmp = path.with_suffix(".json.tmp")
+    tmp.write_text(_json.dumps(doc, indent=1))
+    tmp.replace(path)
 
 
 def ring_local_attention(

@@ -29,6 +29,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 EPS = 1e-20  # reference log() epsilon, utils.py:20
 
@@ -165,6 +166,54 @@ def gumbel_step_dynamic(key, logit, top_k, parity, temperature, top_p):
     return key, jnp.where(parity, pick_parity, pick_knobs)
 
 
+def _validate_infill(template, frozen, length, num_tokens):
+    """Host-side checks for the fixed-position infilling mask pair
+    (the constrained-sampling workload, progen_tpu/workloads/infill.py).
+    Returns (template, frozen) as device-ready (length,) arrays, or
+    (None, None) when infilling is off. ``template`` pins token ids at
+    positions where ``frozen`` is True; free positions sample normally."""
+    if (template is None) != (frozen is None):
+        raise ValueError("template and frozen must be given together")
+    if template is None:
+        return None, None
+    t = np.asarray(template, np.int32).reshape(-1)
+    f = np.asarray(frozen, bool).reshape(-1)
+    if t.shape[0] != length or f.shape[0] != length:
+        raise ValueError(
+            f"template/frozen must be (length={length},) arrays, got "
+            f"{t.shape} / {f.shape}"
+        )
+    if (t < 0).any() or (t >= num_tokens).any():
+        raise ValueError(
+            f"template token ids must be in [0, {num_tokens})"
+        )
+    if ((t == 0) & f).any():
+        raise ValueError(
+            "frozen positions must pin a nonzero token id (0 is the "
+            "BOS/EOS/pad token — freezing it would end the sequence)"
+        )
+    return jnp.asarray(t), jnp.asarray(f)
+
+
+def _constrain(sampled, logit, pos, template, frozen):
+    """Apply the infill mask to one draw at write position ``pos``:
+    frozen positions take the template token verbatim; at free positions
+    a drawn EOS (0) is replaced by the best non-EOS token, because an
+    infill template has a fixed extent and an early EOS would abort the
+    fill. Both overrides are gated on the mask actually freezing
+    something (``frozen.any()``), so an all-free mask is bit-identical
+    to unconstrained sampling under the same key — the draw itself
+    always happens, keeping the one-split-per-token PRNG contract (and
+    journal replay) unchanged. ``logit``/``sampled`` may carry a leading
+    batch axis; ``pos`` is a traced scalar."""
+    alt = (jnp.argmax(logit[..., 1:], axis=-1) + 1).astype(sampled.dtype)
+    infill_on = jnp.any(frozen, axis=-1)
+    sampled = jnp.where(infill_on & (sampled == 0), alt, sampled)
+    frz = jnp.take(frozen, pos, axis=-1)
+    tpl = jnp.take(template, pos, axis=-1).astype(sampled.dtype)
+    return jnp.where(frz, tpl, sampled)
+
+
 def _prepare_seq(model, prime, length, add_bos):
     """Validate and build the fixed-shape decode buffer (shared by ALL
     decode paths): BOS shift (utils.py:110-111), right-padding, and the
@@ -206,9 +255,13 @@ def _decode(
     parity: bool = True,
     temperature: jnp.ndarray = 1.0,
     top_p: jnp.ndarray = _TOP_P_OFF,
+    template=None,
+    frozen=None,
 ):
     """seq: (length,) int32 buffer primed up to start_pos. One fori_loop
-    iteration = one full forward + one Gumbel top-k draw + one scatter."""
+    iteration = one full forward + one Gumbel top-k draw + one scatter.
+    ``template``/``frozen`` (both (length,) or None) are the infilling
+    constraint — see _constrain."""
 
     def body(pos, carry):
         seq, key = carry
@@ -219,6 +272,8 @@ def _decode(
         key, sampled = _gumbel_topk_step(
             key, logit, top_k, parity, temperature, top_p
         )
+        if template is not None:
+            sampled = _constrain(sampled, logit, pos, template, frozen)
         seq = jax.lax.dynamic_update_index_in_dim(
             seq, sampled.astype(seq.dtype), pos, axis=0
         )
@@ -240,19 +295,28 @@ def sample(
     add_bos: bool = False,
     temperature: float = 1.0,
     top_p: Optional[float] = None,
+    template=None,
+    frozen=None,
 ) -> jnp.ndarray:
     """Generate a (length,) token sequence continuing ``prime`` (1-D ints).
 
     Defaults mirror sample.py:70 (top_k=25; train-loop sampling uses
     add_bos=True, train.py:218). ``temperature``/``top_p`` are
     beyond-reference knobs; defaults are exact parity.
+    ``template``/``frozen`` ((length,) arrays) enable fixed-position
+    infilling: frozen positions emit the template token verbatim, free
+    positions sample normally (progen_tpu/workloads/infill.py builds the
+    pair from a template string).
     """
     _validate_knobs(temperature, top_p)
     parity, t_arr, p_arr = _knob_operands(temperature, top_p)
     seq, start = _prepare_seq(model, prime, length, add_bos)
+    template, frozen = _validate_infill(
+        template, frozen, length, model.config.num_tokens
+    )
     return _decode(
         model, params, key, seq, jnp.asarray(start), length, top_k,
-        parity, t_arr, p_arr,
+        parity, t_arr, p_arr, template, frozen,
     )
 
 
@@ -336,13 +400,19 @@ def sample_fast(
     add_bos: bool = False,
     temperature: float = 1.0,
     top_p: Optional[float] = None,
+    template=None,
+    frozen=None,
 ) -> jnp.ndarray:
     """KV-cache decode: O(2w·d) attention per emitted token via the model's
     config.decode mode (rolling two-window ring buffer + token-shift states
     + SGU gate history) instead of the naive path's full forward per token.
-    Same sampling semantics as `sample`."""
+    Same sampling semantics as `sample` (including ``template``/``frozen``
+    infilling)."""
     # validate before the (comparatively) expensive cache-init compile
     seq, start = _prepare_seq(model, prime, length, add_bos)
+    template, frozen = _validate_infill(
+        template, frozen, length, model.config.num_tokens
+    )
     dec_model, params, cache = _decode_setup(model, params, batch=1)
     # the single decode IS the batched kernel at B=1 (row key = the raw
     # key, preserving this function's historical stream); vmapped PRNG
@@ -353,6 +423,8 @@ def sample_fast(
     out = _decode_incremental_batched(
         dec_model, params, cache, key[None], seq[None],
         jnp.asarray(start), length, top_k, parity, t_arr, p_arr,
+        None if template is None else template[None],
+        None if frozen is None else frozen[None],
     )
     return out[0]
 
@@ -389,10 +461,13 @@ def _decode_setup(model, params, batch: int):
 def _decode_incremental_batched(
     model, params, cache, keys, seqs, start_pos, length, top_k,
     parity=True, temperature=1.0, top_p=_TOP_P_OFF,
+    template=None, frozen=None,
 ):
     """Batched KV-cache decode: seqs (B, length), keys (B,) — one
     independent Gumbel stream per row, caches carry a leading batch axis
-    (they are built batch-shaped by the model's decode variables)."""
+    (they are built batch-shaped by the model's decode variables).
+    ``template``/``frozen`` (both (B, length) or None) apply the infill
+    constraint per row — see _constrain."""
 
     def feed(seqs, p, cache):
         tok = jax.lax.dynamic_slice_in_dim(seqs, p, 1, axis=1)  # (B, 1)
@@ -417,6 +492,8 @@ def _decode_incremental_batched(
         seqs, cache, keys = carry
         logit, cache = feed(seqs, p, cache)
         keys, sampled = draw(keys, logit)
+        if template is not None:
+            sampled = _constrain(sampled, logit, p + 1, template, frozen)
         seqs = jax.lax.dynamic_update_slice(
             seqs, sampled[:, None].astype(seqs.dtype), (0, p + 1)
         )

@@ -45,6 +45,7 @@ from progen_tpu.sampling import (
     _TOP_P_OFF,
     _decode_setup,
     _prepare_seq,
+    _validate_infill,
     _validate_knobs,
     gumbel_step_dynamic,
 )
@@ -79,6 +80,8 @@ class SlotBatch(NamedTuple):
     top_k: jnp.ndarray  # (S,) int32 (0 = off)
     parity: jnp.ndarray  # (S,) bool reference-quirk sampling branch
     live: jnp.ndarray  # (S,) bool slot is decoding
+    template: jnp.ndarray  # (S, L) int32 infill template (all-0 = off)
+    frozen: jnp.ndarray  # (S, L) bool infill frozen-position mask
 
 
 def _prefill_impl(
@@ -95,6 +98,8 @@ def _prefill_impl(
     top_p,
     top_k,
     parity,
+    template,
+    frozen,
 ):
     """Admit one request into ``slot``: run the prime through a FRESH
     batch-1 cache (positions 0..start-2; a dynamic-bound fori_loop, so
@@ -138,6 +143,12 @@ def _prefill_impl(
         top_k=slots.top_k.at[slot].set(top_k),
         parity=slots.parity.at[slot].set(parity),
         live=slots.live.at[slot].set(True),
+        template=jax.lax.dynamic_update_index_in_dim(
+            slots.template, template, slot, axis=0
+        ),
+        frozen=jax.lax.dynamic_update_index_in_dim(
+            slots.frozen, frozen, slot, axis=0
+        ),
     )
 
 
@@ -145,21 +156,23 @@ def _prefill_impl(
     jax.jit, static_argnames=("model",), donate_argnums=(2,)
 )
 def _prefill(model, params, slots, fresh_cache, slot, tokens, start,
-             target, key, temp, top_p, top_k, parity):
+             target, key, temp, top_p, top_k, parity, template, frozen):
     """Jitted bf16/f32 prefill. The pool (``slots``, arg 2) is DONATED:
     every leaf is rebuilt each call and the caller immediately rebinds
     ``self.slots`` to the result, so the old buffers alias the new ones
     instead of doubling the pool's HBM footprint. ``fresh_cache`` is NOT
     donated — it is the reusable zero template."""
     return _prefill_impl(model, params, slots, fresh_cache, slot, tokens,
-                         start, target, key, temp, top_p, top_k, parity)
+                         start, target, key, temp, top_p, top_k, parity,
+                         template, frozen)
 
 
 @functools.partial(
     jax.jit, static_argnames=("model",), donate_argnums=(3,)
 )
 def _prefill_q(model, q_params, scales, slots, fresh_cache, slot, tokens,
-               start, target, key, temp, top_p, top_k, parity):
+               start, target, key, temp, top_p, top_k, parity, template,
+               frozen):
     """Int8 prefill: dequantize the per-channel int8 kernels on-device
     (XLA fuses convert+scale into each consuming matmul) and delegate.
     ``slots`` is arg 3 here, donated for the same reason as _prefill."""
@@ -167,7 +180,8 @@ def _prefill_q(model, q_params, scales, slots, fresh_cache, slot, tokens,
         q_params, scales, model.config.compute_dtype
     )
     return _prefill_impl(model, params, slots, fresh_cache, slot, tokens,
-                         start, target, key, temp, top_p, top_k, parity)
+                         start, target, key, temp, top_p, top_k, parity,
+                         template, frozen)
 
 
 def _decode_step_impl(model, params, slots: SlotBatch):
@@ -196,6 +210,18 @@ def _decode_step_impl(model, params, slots: SlotBatch):
     )
     sampled = sampled.astype(slots.seqs.dtype)
     wpos = jnp.clip(slots.cur + 1, 0, length - 1)
+    # infilling (mirrors sampling.py::_constrain so an infilled slot is
+    # bit-identical to sample_fast with the same template): EOS drawn at a
+    # free position becomes the best non-EOS token, frozen positions take
+    # the template token; slots with an all-False mask are untouched
+    alt = (jnp.argmax(logits[:, 1:], axis=-1) + 1).astype(sampled.dtype)
+    infill_on = jnp.any(slots.frozen, axis=1)
+    sampled = jnp.where(infill_on & (sampled == 0), alt, sampled)
+    frz = jnp.take_along_axis(slots.frozen, wpos[:, None], axis=1)[:, 0]
+    tpl = jnp.take_along_axis(
+        slots.template, wpos[:, None], axis=1
+    )[:, 0].astype(sampled.dtype)
+    sampled = jnp.where(frz, tpl, sampled)
     written = slots.seqs.at[jnp.arange(n_slots), wpos].set(sampled)
     seqs = jnp.where(slots.live[:, None], written, slots.seqs)
     nz = slots.nz + ((sampled == 0) & slots.live).astype(jnp.int32)
@@ -213,6 +239,8 @@ def _decode_step_impl(model, params, slots: SlotBatch):
         top_k=slots.top_k,
         parity=slots.parity,
         live=slots.live & ~finished,
+        template=slots.template,
+        frozen=slots.frozen,
     )
     return new, sampled, slots.live, finished
 
@@ -297,9 +325,12 @@ class ServeEngine:
             top_k=jnp.zeros((s,), jnp.int32),
             parity=jnp.ones((s,), bool),
             live=jnp.zeros((s,), bool),
+            template=jnp.zeros((s, l), jnp.int32),
+            frozen=jnp.zeros((s, l), bool),
         )
         self._free = list(range(s))
         self._targets = [l] * s  # host mirror for collect()
+        self._embed_model = None  # lazily built by embed()
         self.quantize_int8 = bool(quantize_int8)
         self.quant_report = None
         self._q_params = self._q_scales = None
@@ -445,7 +476,8 @@ class ServeEngine:
     # ----- request admission ---------------------------------------------
 
     def validate(self, prime, length, *, add_bos: bool = False,
-                 temperature: float = 1.0, top_p=None, top_k=25) -> None:
+                 temperature: float = 1.0, top_p=None, top_k=25,
+                 template=None, frozen=None) -> None:
         """Raise ValueError for anything the pool cannot serve — the same
         checks the standalone decoders apply, plus the pool's max_len
         bound and the dynamic sampler's top_k range. Cheap (no device
@@ -463,24 +495,36 @@ class ServeEngine:
                 f"top_k must be None or in [1, {self.model.config.num_tokens}]"
                 f", got {top_k}"
             )
+        _validate_infill(
+            template, frozen, length, self.model.config.num_tokens
+        )
         _prepare_seq(self.model, prime, length, add_bos)
 
     def prefill(self, slot: int, prime, length: int, *,
                 top_k=25, add_bos: bool = False, temperature: float = 1.0,
                 top_p=None, key=None, seed: int = 0,
-                request_id: Optional[str] = None) -> int:
+                request_id: Optional[str] = None,
+                template=None, frozen=None) -> int:
         """Admit a request into ``slot``. Returns the number of primed
         positions (``start``). The slot's stream is bit-identical to
         ``sample_fast(key, model, params, prime, length, ...)``.
+        ``template``/``frozen`` ((length,) arrays) enable fixed-position
+        infilling for this slot, matching ``sample_fast``'s constraint.
         ``request_id`` is telemetry-only: the prefill span carries it so
         the trace ties device work back to the request's async track."""
         self.validate(prime, length, add_bos=add_bos,
-                      temperature=temperature, top_p=top_p, top_k=top_k)
+                      temperature=temperature, top_p=top_p, top_k=top_k,
+                      template=template, frozen=frozen)
         with _span("serve/prefill", slot=int(slot),
                    request_id="" if request_id is None else str(request_id)):
             seq, start = _prepare_seq(self.model, prime, length, add_bos)
             row = np.zeros((self.max_len,), np.int32)
             row[: int(seq.shape[0])] = np.asarray(seq)
+            trow = np.zeros((self.max_len,), np.int32)
+            frow = np.zeros((self.max_len,), bool)
+            if template is not None:
+                trow[:length] = np.asarray(template, np.int32).reshape(-1)
+                frow[:length] = np.asarray(frozen, bool).reshape(-1)
             if key is None:
                 key = jax.random.PRNGKey(seed)
             parity = temperature == 1.0 and top_p is None
@@ -491,6 +535,7 @@ class ServeEngine:
                 jnp.float32(_TOP_P_OFF if top_p is None else top_p),
                 jnp.int32(0 if top_k is None else top_k),
                 jnp.asarray(parity),
+                jnp.asarray(trow), jnp.asarray(frow),
             )
             if self.quantize_int8:
                 self.slots = _prefill_q(
@@ -534,6 +579,46 @@ class ServeEngine:
         row = row.copy()
         row[np.cumsum(row == 0) > 1] = 0
         return row
+
+    # ----- embeddings extraction ------------------------------------------
+
+    def embed(self, prime, *, add_bos: bool = False) -> np.ndarray:
+        """Final-norm mean-pooled representation of ``prime`` — the
+        embeddings-extraction request type (workloads/embeddings.py).
+        Runs a lazily built NON-decode twin of the served model (one full
+        forward, no KV cache) against the engine's full-precision params
+        — also under int8 serving, where weight-only quantization exists
+        to protect exactly this kind of read-out quality. Lengths are
+        power-of-two bucketed so a ragged request stream reuses a few
+        compiled programs; gMLP models pad to the full seq_len (their
+        SGU matrix admits nothing narrower). Returns (dim,) float32."""
+        from progen_tpu.workloads.embeddings import bucket_length, embed_step
+
+        prime = np.asarray(prime, np.int32).reshape(-1)
+        if add_bos:
+            prime = np.concatenate([np.zeros((1,), np.int32), prime])
+        if prime.shape[0] == 0:
+            raise ValueError("empty prime requires add_bos=True")
+        cfg = self.model.config
+        if self._embed_model is None:
+            import dataclasses
+
+            self._embed_model = type(self.model)(
+                dataclasses.replace(cfg, decode=False, scan_layers=False),
+                mesh=getattr(self.model, "mesh", None),
+            )
+        n = bucket_length(
+            int(prime.shape[0]), cfg.seq_len,
+            minimum=max(8, cfg.window_size),
+            fixed=cfg.global_mlp_depth > 0,
+        )
+        row = np.zeros((1, n), np.int32)
+        row[0, : prime.shape[0]] = prime
+        with _span("serve/embed", n_tokens=int(prime.shape[0])):
+            out = embed_step(
+                self._embed_model, self.params, jnp.asarray(row)
+            )
+        return np.asarray(out[0], np.float32)
 
     # ----- introspection --------------------------------------------------
 

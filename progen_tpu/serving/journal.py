@@ -103,6 +103,15 @@ class RequestJournal:
             "top_p": None if req.top_p is None else float(req.top_p),
             "key": [int(k) for k in np.asarray(key).reshape(-1)],
             "deadline_s": req.deadline_s,
+            "kind": getattr(req, "kind", "generate"),
+            "template": (
+                None if req.template is None
+                else [int(t) for t in np.asarray(req.template).reshape(-1)]
+            ),
+            "frozen": (
+                None if req.frozen is None
+                else [bool(b) for b in np.asarray(req.frozen).reshape(-1)]
+            ),
         })
 
     def token(self, request_id: str, index: int, token: int) -> None:
@@ -189,6 +198,10 @@ def _classify(entry: dict) -> dict:
     )
     if entry["done"] is not None:
         kind = "done"
+    elif acc.get("kind") == "embed":
+        # embeds emit no tokens: start >= length would mis-settle them
+        # as finished — an unsettled embed accept is always resumable
+        kind = "pending"
     elif start + len(emitted) >= length or zeros >= 2:
         kind = "finished"
     else:
@@ -213,6 +226,8 @@ def resume_request(rid: str, cls: dict) -> Request:
     key = _advance_key(
         jnp.asarray(acc["key"], jnp.uint32), len(cls["emitted"])
     )
+    template = acc.get("template")
+    frozen = acc.get("frozen")
     return Request(
         id=rid,
         prime=np.asarray(prime + cls["emitted"], np.int32),
@@ -223,6 +238,9 @@ def resume_request(rid: str, cls: dict) -> Request:
         top_p=acc.get("top_p"),
         key=key,
         deadline_s=None,
+        kind=acc.get("kind", "generate"),
+        template=None if template is None else np.asarray(template, np.int32),
+        frozen=None if frozen is None else np.asarray(frozen, bool),
     )
 
 

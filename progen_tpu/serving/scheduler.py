@@ -54,7 +54,13 @@ class Request:
     still waiting for a slot past it is expired (reject reason
     ``deadline_exceeded``) instead of admitted — serving a response the
     client has already timed out on just wastes decode steps. Requests
-    already on a slot are never expired mid-decode."""
+    already on a slot are never expired mid-decode.
+
+    ``kind`` selects the workload: ``"generate"`` (the decode slots) or
+    ``"embed"`` (embeddings extraction — answered at admission time with
+    one full forward, ``length`` ignored). ``template``/``frozen`` are
+    the fixed-position infilling constraint for generate requests
+    ((length,) arrays, see workloads/infill.py)."""
 
     id: str
     prime: object  # 1-D int token ids
@@ -66,6 +72,9 @@ class Request:
     seed: int = 0
     key: object = None
     deadline_s: Optional[float] = None
+    kind: str = "generate"
+    template: object = None  # (length,) int32 or None
+    frozen: object = None  # (length,) bool or None
 
 
 @dataclasses.dataclass
@@ -86,6 +95,8 @@ class Completion:
     n_generated: int
     ttft_s: float
     latency_s: float
+    # embed requests complete with a vector instead of tokens
+    embedding: Optional[np.ndarray] = None  # (dim,) float32
 
 
 @dataclasses.dataclass
@@ -126,6 +137,9 @@ class Scheduler:
         # queued requests expired/shed since the last ``pop_expired()``:
         # (request, reason) — the front-end owns client notification
         self._expired: List[Tuple[Request, str]] = []
+        # embed completions produced during _admit, delivered by the
+        # enclosing step()'s return
+        self._embed_done: List[Completion] = []
         self._last_slots_emitted: Optional[int] = None
         # latency families exist (at zero) from construction so the
         # Prometheus exposition is stable before the first request
@@ -204,11 +218,25 @@ class Scheduler:
         the engine can never serve the request."""
         self.metrics.inc("requests_submitted")
         try:
-            self.engine.validate(
-                req.prime, req.length, add_bos=req.add_bos,
-                temperature=req.temperature, top_p=req.top_p,
-                top_k=req.top_k,
-            )
+            if req.kind == "embed":
+                # embeds run one full forward, no decode slot: the only
+                # bound is the model's context window
+                n = len(np.asarray(req.prime).reshape(-1))
+                n += 1 if req.add_bos else 0
+                if not 1 <= n <= self.engine.model.config.seq_len:
+                    raise ValueError(
+                        f"embed prime must be 1..seq_len="
+                        f"{self.engine.model.config.seq_len} tokens, got {n}"
+                    )
+            elif req.kind == "generate":
+                self.engine.validate(
+                    req.prime, req.length, add_bos=req.add_bos,
+                    temperature=req.temperature, top_p=req.top_p,
+                    top_k=req.top_k, template=req.template,
+                    frozen=req.frozen,
+                )
+            else:
+                raise ValueError(f"unknown request kind {req.kind!r}")
         except ValueError as e:
             self.metrics.inc("requests_rejected")
             self.metrics.inc("rejected_invalid")
@@ -294,8 +322,43 @@ class Scheduler:
         self.metrics.set_gauge("queue_depth", 0)
         return n
 
+    def _serve_embed(self, req: Request, t_submit: float) -> None:
+        """Answer an embed request at admission time: one full forward,
+        no decode slot occupied, completion delivered by the next
+        ``step()`` return. Runs inline in the admission loop — strictly
+        FIFO with generation (an embed behind a queued generate waits its
+        turn, same as a slot would)."""
+        w0 = time.time()
+        self._req_event("e", req.id, "queued", ts=w0)
+        self._req_event("b", req.id, "embed", ts=w0)
+        t0 = self._clock()
+        vec = self.engine.embed(req.prime, add_bos=req.add_bos)
+        t1 = self._clock()
+        w1 = time.time()
+        self._req_event("e", req.id, "embed", ts=w1)
+        self._req_event("e", req.id, "request", ts=w1, dim=int(vec.shape[0]))
+        self.metrics.inc("embed_requests")
+        self.metrics.add_time("embed_time_s", t1 - t0)
+        self.metrics.observe("latency_s", t1 - t_submit)
+        if self.journal is not None:
+            self.journal.done(req.id, "completed", 0)
+        self._embed_done.append(
+            Completion(
+                request_id=req.id,
+                tokens=np.zeros((0,), np.int32),
+                n_generated=0,
+                ttft_s=t1 - t_submit,
+                latency_s=t1 - t_submit,
+                embedding=vec,
+            )
+        )
+
     def _admit(self) -> None:
         while self._queue:
+            if self._queue[0][0].kind == "embed":
+                req, t_submit = self._queue.popleft()
+                self._serve_embed(req, t_submit)
+                continue
             slot = self.engine.acquire()
             if slot is None:
                 break
@@ -308,7 +371,8 @@ class Scheduler:
                 slot, req.prime, req.length, top_k=req.top_k,
                 add_bos=req.add_bos, temperature=req.temperature,
                 top_p=req.top_p, key=req.key, seed=req.seed,
-                request_id=req.id,
+                request_id=req.id, template=req.template,
+                frozen=req.frozen,
             )
             t1 = self._clock()
             w1 = time.time()
@@ -331,8 +395,9 @@ class Scheduler:
         dead deadline never consumes a freed slot."""
         self._expire_queued(self._clock())
         self._admit()
+        embed_done, self._embed_done = self._embed_done, []
         if not self._active:
-            return [], []
+            return [], embed_done
         # chaos site (PROGEN_CHAOS="serve/decode:kill@N"): decode has no
         # span of its own (per-token span records would swamp the
         # trace), so the injector is called directly, like the
@@ -385,7 +450,7 @@ class Scheduler:
         self.metrics.inc("decode_tokens", n_live)
         self.metrics.add_time("decode_time_s", t1 - t0)
         self.metrics.set_gauge("active_slots", len(self._active))
-        return events, completions
+        return events, embed_done + completions
 
     def _finish(self, slot: int, rec: _Active, now: float) -> Completion:
         tokens = self.engine.collect(slot)

@@ -32,6 +32,32 @@ def eos_loss_mask(targets: jnp.ndarray, ignore_index: int = 0) -> jnp.ndarray:
     return nonpad | first_pad
 
 
+def token_logprobs(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    """Per-position ``log p(target)``: logits (..., n, vocab), targets
+    (..., n) ints -> (..., n) float32. The single fused log-softmax every
+    scoring path shares — eval, the batch-score workload, and the training
+    loss all reduce THIS array, so their numbers are bit-comparable."""
+    logits = logits.astype(jnp.float32)
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    return jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
+
+
+def sequence_scores(
+    logits: jnp.ndarray,
+    targets: jnp.ndarray,
+    *,
+    ignore_index: int = 0,
+) -> tuple:
+    """(per_seq_nll, per_token_logprob, loss_mask) — the one scoring
+    function ``cli/eval.py`` and ``workloads/scoring.py`` both reduce
+    from (test-locked equal on a fixed batch in tests/test_workloads.py).
+    ``per_seq_nll`` has shape ``logits.shape[:-2]`` (masked mean over each
+    sequence's kept positions); the other two are (..., n)."""
+    lp = token_logprobs(logits, targets)
+    mask = eos_loss_mask(targets, ignore_index)
+    return masked_mean(-lp, mask, axis=-1), lp, mask
+
+
 def cross_entropy(
     logits: jnp.ndarray,
     targets: jnp.ndarray,
@@ -44,8 +70,4 @@ def cross_entropy(
     mean over each sequence's kept positions. Callers average over the batch
     (see make_train_step), matching the reference's vmap-then-mean.
     """
-    logits = logits.astype(jnp.float32)
-    logprobs = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
-    mask = eos_loss_mask(targets, ignore_index)
-    return masked_mean(nll, mask, axis=-1)
+    return sequence_scores(logits, targets, ignore_index=ignore_index)[0]

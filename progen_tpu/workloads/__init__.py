@@ -1,0 +1,56 @@
+"""Protein-design workloads on the serving/scoring stack.
+
+Four production workloads (ISSUE 10 / the ProGen paper's conditional-use
+protocols) layered on the compile-once machinery the trainer and server
+already share:
+
+  * ``scoring`` — bulk perplexity scoring (``progen-tpu-batch-score``):
+    FASTA/TFRecord candidates -> sharded JSONL of per-sequence NLL/
+    perplexity + per-token logprobs, length-bucketed, resumable
+    (SIGKILL-safe), with goodput + Prometheus progress telemetry;
+  * ``mutagenesis`` — deep mutational scans (``progen-tpu-scan``):
+    every point mutant of a sequence scored in one compiled call;
+  * ``infill`` — fixed-position infilling templates -> the sampler's
+    (template, frozen) constraint pair (sampling.py::_constrain),
+    exposed in ``sample``/``sample_fast`` and the serve protocol;
+  * ``embeddings`` — final-norm mean-pooled representations, also a
+    serving-engine request type (ServeEngine.embed / ``"embed"``
+    requests in cli/serve.py).
+
+Nothing here imports ``progen_tpu.serving`` — the engine imports
+``embeddings`` lazily, keeping the dependency one-directional.
+"""
+
+from progen_tpu.workloads.embeddings import bucket_length, embed_step
+from progen_tpu.workloads.infill import infill_request_arrays, parse_template
+from progen_tpu.workloads.mutagenesis import (
+    AA_ALPHABET,
+    mutagenesis_scan,
+    reference_point_mutant_nll,
+)
+from progen_tpu.workloads.scoring import (
+    SCORE_OPS,
+    ScoreJournal,
+    fasta_records,
+    run_batch_score,
+    score_step,
+    scored_ids,
+    tfrecord_records,
+)
+
+__all__ = [
+    "AA_ALPHABET",
+    "SCORE_OPS",
+    "ScoreJournal",
+    "bucket_length",
+    "embed_step",
+    "fasta_records",
+    "infill_request_arrays",
+    "mutagenesis_scan",
+    "parse_template",
+    "reference_point_mutant_nll",
+    "run_batch_score",
+    "score_step",
+    "scored_ids",
+    "tfrecord_records",
+]

@@ -1,0 +1,65 @@
+"""Embeddings extraction: final-layer pooled representations.
+
+The representation is the output of the model's top-level final
+``ScaleNorm`` (the pre-``to_logits`` activations, progen.py:195) captured
+via flax ``capture_intermediates``, mean-pooled over non-pad positions —
+the standard protein-LM embedding recipe (per-residue states averaged
+over the sequence). Returned in float32 regardless of compute dtype.
+
+This module must NOT import ``progen_tpu.serving`` — the serving engine
+imports it lazily (ServeEngine.embed) to expose embeddings as a request
+type, and a cycle here would break that.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from progen_tpu.models.layers import ScaleNorm
+
+
+def _capture_final_norm(mdl, method):
+    return isinstance(mdl, ScaleNorm)
+
+
+@functools.partial(jax.jit, static_argnames=("model",))
+def embed_step(model, params, tokens: jnp.ndarray) -> jnp.ndarray:
+    """tokens (B, n) int32 (0 = pad) -> (B, dim) float32 mean-pooled
+    final-norm states. Compile-once per (model, n): callers bucket n
+    (see bucket_length) so a stream of ragged requests reuses a handful
+    of compiled programs."""
+    _, state = model.apply(
+        {"params": params},
+        tokens,
+        capture_intermediates=_capture_final_norm,
+        mutable=["intermediates"],
+    )
+    # the top-level (unnamed) final norm auto-names ScaleNorm_0; block
+    # norms are nested under attn*/ff* so they don't collide
+    h = state["intermediates"]["ScaleNorm_0"]["__call__"][0]
+    h = h.astype(jnp.float32)
+    mask = (tokens != 0).astype(jnp.float32)[..., None]
+    denom = jnp.maximum(mask.sum(axis=1), 1.0)
+    return (h * mask).sum(axis=1) / denom
+
+
+def bucket_length(
+    n: int, max_len: int, minimum: int = 8, fixed: bool = False
+) -> int:
+    """Smallest power of two >= n (floor ``minimum``), capped at
+    ``max_len`` — the compile-once bucketing shared with the scorer.
+    ``fixed`` pads straight to max_len: gMLP models bind a
+    (seq_len, seq_len) SGU matrix, so their non-decode forward only
+    accepts full-width inputs (callers pass
+    ``config.global_mlp_depth > 0``)."""
+    if n > max_len:
+        raise ValueError(f"sequence length {n} exceeds max_len {max_len}")
+    if fixed:
+        return max_len
+    b = minimum
+    while b < n:
+        b *= 2
+    return min(b, max_len)

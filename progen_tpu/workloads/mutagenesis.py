@@ -1,0 +1,165 @@
+"""Mutagenesis scans: every point mutant of a sequence in one compiled call.
+
+Deep mutational scanning in silico (the ProGen paper's zero-shot fitness
+protocol): for each scanned position p and each substitution a, score the
+full sequence with residue p replaced by a. Building the P x A mutant
+batch INSIDE the jitted program (a vmapped ``.at[].set()`` over the
+wild-type row) means the host ships one (L,) row + index vectors instead
+of P·A·L tokens, and ``lax.map`` over fixed-size chunks keeps peak memory
+at chunk x L logits while everything stays one XLA program — positions/
+alphabet ride as traced operands, so scanning a different region of the
+same-length protein re-executes without retracing.
+
+Scores are the shared sequence NLL (training/loss.py::sequence_scores),
+so ``delta_nll = wt_nll - mutant_nll`` is a log-likelihood ratio: positive
+means the mutant is MORE likely than wild type under the model.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from progen_tpu.data.tokenizer import encode_tokens
+from progen_tpu.training.loss import sequence_scores
+
+# the 20 canonical amino acids, alphabetical one-letter codes
+AA_ALPHABET = "ACDEFGHIKLMNPQRSTVWY"
+
+
+@functools.partial(jax.jit, static_argnames=("model", "chunk"))
+def _scan_nll(model, params, row, pos_idx, aa_tokens, chunk: int):
+    """row (L,) int32 wild-type buffer (BOS at 0); pos_idx (P,) int32 row
+    indices to mutate; aa_tokens (A,) int32 substitution ids. Returns
+    ((P, A) mutant NLLs, wild-type NLL) — all P·A+pad forwards from one
+    compiled program. Padding rows (up to the chunk multiple) are the
+    unmutated wild type, so wt_nll falls out of the same batch free."""
+    P, A = pos_idx.shape[0], aa_tokens.shape[0]
+    total = P * A + 1  # + one wild-type row
+    padded = ((total + chunk - 1) // chunk) * chunk
+
+    def build(i):
+        # i >= P*A -> wild type: keep the row by "mutating" position 0
+        # (the BOS column) to its own value
+        safe = jnp.minimum(i, P * A - 1)
+        idx = jnp.where(i < P * A, pos_idx[safe // A], 0)
+        tok = jnp.where(i < P * A, aa_tokens[safe % A], row[0])
+        return row.at[idx].set(tok.astype(row.dtype))
+
+    rows = jax.vmap(build)(jnp.arange(padded))
+
+    def score_chunk(chunk_rows):
+        ids, labels = chunk_rows[:, :-1], chunk_rows[:, 1:]
+        logits = model.apply({"params": params}, ids)
+        return sequence_scores(logits, labels)[0]
+
+    nll = jax.lax.map(
+        score_chunk, rows.reshape(padded // chunk, chunk, -1)
+    ).reshape(-1)
+    return nll[: P * A].reshape(P, A), nll[P * A]
+
+
+def mutagenesis_scan(
+    model,
+    params,
+    sequence: str,
+    *,
+    context: str = "",
+    positions: Optional[Sequence[int]] = None,
+    alphabet: str = AA_ALPHABET,
+    chunk: int = 32,
+    top: int = 20,
+) -> dict:
+    """Score every (position, substitution) point mutant of ``sequence``.
+
+    ``context`` is an optional conditioning tag (the ``[tax=...]``
+    annotation grammar); the scored string is ``context + " # " + seq``
+    with mutations applied only inside the sequence region.
+    ``positions`` are 0-based residue indices into ``sequence`` (default:
+    all). Returns a report dict: ``nll`` is the (P, A) float array,
+    ``top`` the K best substitutions by ``delta_nll = wt_nll - nll``
+    (self-substitutions excluded — they are the wild type itself).
+    """
+    seq_len = model.config.seq_len
+    if not sequence:
+        raise ValueError("empty sequence")
+    prefix = f"{context} # " if context else "# "
+    raw = prefix + sequence
+    toks = encode_tokens(raw)
+    # full-width training layout (BOS, tokens, EOS-then-pad out to
+    # seq_len+1) — the forward needs exactly seq_len columns (window
+    # divisibility, and the SGU matrix for gMLP models); the loss mask
+    # keeps tokens + the first pad, so the padding is free
+    if len(toks) + 2 > seq_len + 1:
+        raise ValueError(
+            f"sequence needs {len(toks) + 2} tokens > model seq_len+1 "
+            f"{seq_len + 1}"
+        )
+    row = np.zeros((seq_len + 1,), np.int32)
+    row[1 : 1 + len(toks)] = toks
+
+    if positions is None:
+        positions = range(len(sequence))
+    positions = sorted(set(int(p) for p in positions))
+    if not positions:
+        raise ValueError("no positions to scan")
+    for p in positions:
+        if not 0 <= p < len(sequence):
+            raise ValueError(
+                f"position {p} outside sequence of length {len(sequence)}"
+            )
+    # residue p lives at row index len(prefix) + p + 1 (BOS shift)
+    pos_idx = np.asarray([len(prefix) + p + 1 for p in positions], np.int32)
+    aa_tokens = encode_tokens(alphabet).astype(np.int32)
+
+    nll, wt_nll = _scan_nll(
+        model, params, jnp.asarray(row), jnp.asarray(pos_idx),
+        jnp.asarray(aa_tokens), chunk,
+    )
+    nll = np.asarray(nll)
+    wt_nll = float(wt_nll)
+
+    entries = []
+    for i, p in enumerate(positions):
+        wt_aa = sequence[p]
+        for j, aa in enumerate(alphabet):
+            if aa == wt_aa:
+                continue  # self-substitution IS the wild type
+            entries.append(
+                {
+                    "pos": p,
+                    "wt": wt_aa,
+                    "aa": aa,
+                    "nll": float(nll[i, j]),
+                    "delta_nll": wt_nll - float(nll[i, j]),
+                }
+            )
+    entries.sort(key=lambda e: -e["delta_nll"])
+    return {
+        "sequence": sequence,
+        "context": context,
+        "wt_nll": wt_nll,
+        "positions": positions,
+        "alphabet": alphabet,
+        "nll": nll,
+        "top": entries[: max(top, 0)],
+    }
+
+
+def reference_point_mutant_nll(model, params, sequence: str, *,
+                               context: str = "", position: int = 0,
+                               aa: str = "A") -> float:
+    """Loop-reference scorer for ONE mutant — the independent oracle the
+    vmapped scan is tested against (one un-vmapped forward per call)."""
+    mutated = sequence[:position] + aa + sequence[position + 1:]
+    prefix = f"{context} # " if context else "# "
+    toks = encode_tokens(prefix + mutated)
+    row = np.zeros((model.config.seq_len + 1,), np.int32)
+    row[1 : 1 + len(toks)] = toks
+    ids, labels = row[None, :-1], row[None, 1:]
+    logits = model.apply({"params": params}, jnp.asarray(ids))
+    return float(sequence_scores(logits, jnp.asarray(labels))[0][0])

@@ -48,3 +48,10 @@ def clean_router_metrics(reg):
     reg.inc("handoff_resumed")
     reg.set_gauge("replicas_up", 2)
     reg.observe("latency_s", 0.2)
+
+
+def clean_score_metrics(reg):
+    # scoring METRICS are fine anywhere — only raw ev:"score" records
+    # are restricted to progen_tpu/workloads/
+    reg.inc("sequences_scored", 8)
+    reg.set_gauge("goodput_pct", 91.0)

@@ -1,4 +1,4 @@
-"""PGL006 true positives: telemetry hygiene. Expected findings: 14."""
+"""PGL006 true positives: telemetry hygiene. Expected findings: 17."""
 
 
 def unbounded_span(telemetry, name):
@@ -50,3 +50,14 @@ def bad_route_status(emit):
     # TP x2: outside serving/router.py AND a status outside the
     # dispatched/handoff/shed/replica_down routing alphabet
     emit({"ev": "route", "status": "rerouted", "replica": 1})
+
+
+def raw_score_record(emit):
+    # TP: score record outside progen_tpu/workloads/
+    emit({"ev": "score", "op": "batch", "n": 4})
+
+
+def bad_score_op(emit):
+    # TP x2: outside workloads/ AND an op outside the
+    # start/resume/batch/skip/done scoring alphabet
+    emit({"ev": "score", "op": "progress", "n": 4})
