@@ -47,6 +47,14 @@ dashboard query then matches nothing. Three checks:
     ``start``/``resume``/``batch``/``skip``/``done`` — the batch-score
     journal's grammar is the resume/progress contract the CI workloads
     smoke (and ``summarize``) read.
+  * raw ``"ev": "slo"`` records must not be emitted outside
+    ``telemetry/slo.py`` — the watchtower's transition grammar is what
+    the SLO gate and summarize key on — and a literal ``"state"`` must
+    be one of ``ok``/``warn``/``burning``/``resolved``.
+  * the trace-context field on ``req``/``route`` records is spelled
+    exactly ``trace_id`` — the stitcher's journey grouping and the
+    kill-matrix contiguity assert grep that one key; a literal
+    ``"trace"``/``"traceid"``-style key is a silently-dropped hop.
 """
 
 from __future__ import annotations
@@ -161,6 +169,7 @@ class TelemetryHygieneRule(Rule):
                         "records",
                     )
                 self._check_req_ph(d)
+                self._check_trace_key(d)
             elif v.value == "route":
                 if not self._in_module("serving/router.py"):
                     self.report(
@@ -178,6 +187,7 @@ class TelemetryHygieneRule(Rule):
                     "an unknown status is invisible to the router "
                     "table in summarize and to the failover smoke",
                 )
+                self._check_trace_key(d)
             elif v.value == "journal":
                 if not self._in_module("serving/journal.py"):
                     self.report(
@@ -226,6 +236,22 @@ class TelemetryHygieneRule(Rule):
                     "an unknown op is invisible to the scoring progress "
                     "tooling and the resume smoke",
                 )
+            elif v.value == "slo":
+                if not self._in_module("telemetry/slo.py"):
+                    self.report(
+                        v,
+                        "raw slo record emitted outside "
+                        "telemetry/slo.py — objective-state transitions "
+                        "are the watchtower's judgment, keyed on by the "
+                        "SLO gate and summarize; go through SloWatch, "
+                        "not hand-rolled records",
+                    )
+                self._check_literal_member(
+                    d, "state", ("ok", "warn", "burning", "resolved"),
+                    "slo record 'state'",
+                    "the gate's exit-code contract and the transition "
+                    "grammar only know these states",
+                )
             elif not _PROM_NAME_RE.match(v.value):
                 self.report(
                     v,
@@ -245,6 +271,26 @@ class TelemetryHygieneRule(Rule):
                     f"events only use 'b' (begin), 'n' (instant), "
                     f"'e' (end); anything else is dropped by the "
                     f"trace builder",
+                )
+
+    # misspellings of the one blessed trace-context key: the stitcher's
+    # journey grouping greps records for exactly "trace_id", so a hop
+    # written under any of these never joins its journey
+    _TRACE_MISSPELLINGS = (
+        "trace", "traceid", "traceId", "trace_ctx", "trace_context",
+        "span_id", "spanid",
+    )
+
+    def _check_trace_key(self, d: ast.Dict) -> None:
+        for k in d.keys:
+            if _str_const(k) and k.value in self._TRACE_MISSPELLINGS:
+                self.report(
+                    k,
+                    f"trace-context key '{k.value}' — the blessed "
+                    f"spelling is 'trace_id' (stitch journey grouping "
+                    f"and the kill-matrix contiguity assert grep "
+                    f"exactly that key); a misspelled hop silently "
+                    f"falls out of its journey",
                 )
 
     def _check_literal_member(self, d: ast.Dict, field: str,

@@ -152,6 +152,11 @@ def _parse_request(line, defaults):
             key=key,
             deadline_s=(None if obj.get("deadline_s") is None
                         else float(obj["deadline_s"])),
+            # cross-process trace context minted by the router (or an
+            # upstream client): stamped on this replica's req records
+            # and journaled, so the fleet trace stays one journey
+            trace_id=(None if obj.get("trace_id") is None
+                      else str(obj["trace_id"])),
         )
         return req, None
     except (ValueError, TypeError) as e:

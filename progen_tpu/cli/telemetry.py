@@ -17,9 +17,17 @@ post-mortem actually wants:
     from a serve run, and resilience event counts.
   * ``stitch`` — N hosts' events.jsonl → ONE fleet trace on a common
     corrected clock (clock_beacon-anchored skew correction, cross-host
-    step flow arrows, fleet-wide goodput skew).
+    step flow arrows, fleet-wide goodput skew). ``--force-hosts`` gives
+    each input file its own process track (serving fleets share one
+    host) and unlocks the per-request journey flows: router dispatch →
+    replica track, with ``handoff`` arrows into the survivor when a
+    replica died midstream.
+  * ``slo-report`` — the fleet SLO gate (telemetry/slo.py): objectives
+    from TOML, burn rates over metrics.jsonl / Prometheus textfiles,
+    exit 0 (ok) / 1 (warn) / 2 (burning) for CI, ``--watch`` for a live
+    loop emitting ``ev: "slo"`` transition records.
 
-All three report how many torn/garbage input lines they had to skip —
+Every reader reports how many torn/garbage input lines it had to skip —
 a trace that silently lost records is an observability bug.
 
 Run: python -m progen_tpu.cli.telemetry export-trace logs/events.jsonl
@@ -27,10 +35,14 @@ Run: python -m progen_tpu.cli.telemetry export-trace logs/events.jsonl
 
 from __future__ import annotations
 
+import json
+import sys
+import time
 from pathlib import Path
 
 import click
 
+from progen_tpu.telemetry import slo as slo_mod
 from progen_tpu.telemetry.goodput import goodput_skew
 from progen_tpu.telemetry.registry import _Timing
 from progen_tpu.telemetry.stitch import stitch_trace
@@ -107,18 +119,28 @@ def export_trace_cmd(events, metrics, out):
     "--reference", default=0, show_default=True,
     help="host whose clock the fleet is corrected onto",
 )
-def stitch_cmd(events, metrics_paths, out, reference):
+@click.option(
+    "--force-hosts", is_flag=True, default=False,
+    help="assign each EVENTS file its argument position as its process "
+         "track (serving fleets all stamp host 0; distinct tracks are "
+         "required for per-request journey flows)",
+)
+def stitch_cmd(events, metrics_paths, out, reference, force_hosts):
     """Merge N hosts' EVENTS files into ONE clock-aligned fleet trace.
 
     Per-host clock skew is corrected from the clock_beacon records the
     train loop emits at step boundaries (median beacon delta vs the
     reference host); cross-host step_sync flow arrows link each step's
-    beacons so a straggler renders as an arrow fan."""
+    beacons so a straggler renders as an arrow fan. Request records
+    carrying a trace_id are additionally linked into per-request
+    journeys (dispatch/handoff flow arrows router → replica) and
+    tabulated under the trace's progenTraces key."""
     if out is None:
         out = str(Path(events[0]).with_name("stitched_trace.json"))
     trace = stitch_trace(
         list(events), out_path=out,
         metrics_paths=list(metrics_paths), reference=reference,
+        force_hosts=force_hosts,
     )
     info = trace.get("progenStitch", {})
     offsets = trace.get("progenClockOffsets", {})
@@ -140,6 +162,14 @@ def stitch_cmd(events, metrics_paths, out, reference):
         click.echo(
             "  no clock_beacon records found — streams merged on raw "
             "(uncorrected) host clocks"
+        )
+    journeys = trace.get("progenTraces", {})
+    if journeys:
+        handoffs = sum(j.get("handoffs", 0) for j in journeys.values())
+        click.echo(
+            f"  {len(journeys)} request journeys, "
+            f"{info.get('request_flows', 0)} dispatch/handoff arrows"
+            + (f" ({handoffs} handoffs)" if handoffs else "")
         )
     _echo_drops(trace.get("progenDroppedLines", 0))
     click.echo("open at https://ui.perfetto.dev or chrome://tracing")
@@ -191,8 +221,25 @@ def _host_reports(events_path, metrics_path, drops=None) -> list:
     show_default=True,
     help="max span families in the latency table",
 )
-def summarize_cmd(events, metrics, top_spans):
-    """Per-host goodput + skew, span latency quantiles, event counts."""
+@click.option(
+    "--traces",
+    "top_traces",
+    type=int,
+    default=10,
+    show_default=True,
+    help="max rows in the per-trace request journey table",
+)
+@click.option(
+    "--slo",
+    "slo_path",
+    type=click.Path(exists=True, dir_okay=False),
+    default=None,
+    help="SLO objectives TOML — adds a burn-rate section judged over "
+         "the metrics stream (report only, no exit-code gate)",
+)
+def summarize_cmd(events, metrics, top_spans, top_traces, slo_path):
+    """Per-host goodput + skew, span latency quantiles, request
+    journeys, SLO burn rates, event counts."""
     events = Path(events)
     if metrics is None:
         sibling = events.with_name("metrics.jsonl")
@@ -240,6 +287,7 @@ def summarize_cmd(events, metrics, top_spans):
     counts: dict = {}
     open_req: dict = {}
     routes: list = []
+    journeys: dict = {}
     for rec in iter_jsonl(events, drops):
         ev = rec.get("ev")
         if ev == "E" and "dur_s" in rec:
@@ -258,6 +306,23 @@ def summarize_cmd(events, metrics, top_spans):
                     timings.setdefault(
                         f"req/{name}", _Timing()
                     ).observe(float(rec["ts"]) - float(t0))
+            # trace_id-carrying records fold into per-request journeys
+            tr, ts = rec.get("trace_id"), rec.get("ts")
+            if tr is not None and ts is not None:
+                j = journeys.setdefault(str(tr), {
+                    "t0": float(ts), "t1": float(ts), "hops": 0,
+                    "handoffs": 0, "shed": False, "reqs": set(),
+                })
+                j["t0"] = min(j["t0"], float(ts))
+                j["t1"] = max(j["t1"], float(ts))
+                if rid is not None:
+                    j["reqs"].add(str(rid))
+                if ph == "b" and name == "dispatched":
+                    j["hops"] += 1
+                    if rec.get("resumed"):
+                        j["handoffs"] += 1
+                elif ph == "n" and name == "shed":
+                    j["shed"] = True
         elif ev not in ("B", "E", None):
             counts[str(ev)] = counts.get(str(ev), 0) + 1
             if ev == "route":
@@ -329,6 +394,27 @@ def summarize_cmd(events, metrics, top_spans):
             click.echo(f"shed at the router (no replica): {shed_router}")
         click.echo("")
 
+    if journeys:
+        # per-request journeys: every req record carrying the router's
+        # trace_id, longest (slowest end-to-end) first
+        click.echo("== request journeys (by trace_id) ==")
+        click.echo(
+            f"{'trace':<18} {'span_s':>8} {'hops':>5} {'handoffs':>9} "
+            f"{'shed':>5}"
+        )
+        rows = sorted(
+            journeys.items(), key=lambda kv: kv[1]["t1"] - kv[1]["t0"],
+            reverse=True,
+        )
+        for tr, j in rows[:top_traces]:
+            click.echo(
+                f"{tr:<18} {j['t1'] - j['t0']:>8.3f} {j['hops']:>5} "
+                f"{j['handoffs']:>9} {'yes' if j['shed'] else '-':>5}"
+            )
+        if len(rows) > top_traces:
+            click.echo(f"... {len(rows) - top_traces} more (--traces)")
+        click.echo("")
+
     serve_row = None
     router_row = None
     if metrics is not None and Path(metrics).exists():
@@ -370,6 +456,19 @@ def summarize_cmd(events, metrics, top_spans):
             )
         click.echo("")
 
+    if slo_path is not None:
+        cfg = slo_mod.load_objectives(slo_path)
+        series = []
+        if metrics is not None and Path(metrics).exists():
+            series.append(slo_mod.samples_from_metrics(
+                iter_jsonl(metrics, drops)
+            ))
+        click.echo("== SLOs ==")
+        click.echo(
+            slo_mod.render_report(cfg, slo_mod.evaluate(cfg, series))
+        )
+        click.echo("")
+
     if counts:
         click.echo("== events ==")
         order = [e for e in INSTANT_EVENTS if e in counts]
@@ -377,6 +476,131 @@ def summarize_cmd(events, metrics, top_spans):
         for ev in order:
             click.echo(f"{ev:<24} {counts[ev]:>6}")
     _echo_drops(drops.count)
+
+
+_DEFAULT_OBJECTIVES = (
+    Path(__file__).resolve().parents[2] / "configs" / "serving"
+    / "slo.toml"
+)
+
+
+@main.command("slo-report")
+@click.option(
+    "--objectives", type=click.Path(exists=True, dir_okay=False),
+    default=None,
+    help="SLO TOML (default: the repo's configs/serving/slo.toml)",
+)
+@click.option(
+    "--metrics", "metrics_paths", multiple=True,
+    type=click.Path(exists=True, dir_okay=False),
+    help="metrics.jsonl time series, repeatable (router + replicas)",
+)
+@click.option(
+    "--prom", "prom_paths", multiple=True,
+    type=click.Path(dir_okay=False),
+    help="Prometheus exposition textfile, repeatable; mtime age past "
+         "burn.stale_after_s marks the source stale",
+)
+@click.option(
+    "--events-out", type=click.Path(dir_okay=False), default=None,
+    help="append ev:slo state-transition records to this events.jsonl",
+)
+@click.option(
+    "--json", "json_out", type=click.Path(dir_okay=False), default=None,
+    help="also write the full results as JSON (CI artifact)",
+)
+@click.option(
+    "--watch", "watch_s", type=float, default=None,
+    help="live mode: re-evaluate every N seconds on the wall clock "
+         "(default: judge the archived artifacts once and exit)",
+)
+@click.option(
+    "--max-ticks", type=int, default=0, show_default=True,
+    help="stop --watch after N evaluations (0 = run until killed)",
+)
+def slo_report_cmd(
+    objectives, metrics_paths, prom_paths, events_out, json_out,
+    watch_s, max_ticks,
+):
+    """Judge the fleet's SLOs and exit 0 (ok) / 1 (warn) / 2 (burning).
+
+    Report mode (no --watch) is deterministic over archived artifacts:
+    "now" is the newest metrics sample, so re-running the gate on the
+    same files always yields the same verdict. --watch re-reads the
+    sources every tick on the wall clock and emits ev:"slo" transition
+    records (to --events-out, or the process telemetry sink)."""
+    cfg = slo_mod.load_objectives(
+        objectives if objectives is not None else _DEFAULT_OBJECTIVES
+    )
+    drops = LineDrops()
+
+    def _gather():
+        series = [
+            slo_mod.samples_from_metrics(iter_jsonl(mp, drops))
+            for mp in metrics_paths
+        ]
+        proms = []
+        for pp in prom_paths:
+            got = slo_mod.read_prom_file(pp)
+            if got is None:
+                click.echo(f"WARNING: prom file missing: {pp}", err=True)
+            else:
+                proms.append(got)
+        return series, proms
+
+    sink = None
+    watch = None
+    if events_out is not None:
+        from progen_tpu.telemetry.spans import EventLog
+
+        sink = EventLog(events_out)
+        watch = slo_mod.SloWatch(cfg, emit=sink.emit)
+
+    if watch_s is None:
+        series, proms = _gather()
+        results = slo_mod.evaluate(cfg, series, proms)
+        if watch is not None:
+            watch.observe(results)
+    else:
+        ticks = 0
+        results = []
+        if watch is None:
+            watch = slo_mod.SloWatch(cfg)  # process telemetry sink
+        while True:
+            series, proms = _gather()
+            results = slo_mod.evaluate(
+                cfg, series, proms, now=time.time()
+            )
+            for rec in watch.observe(results):
+                click.echo(
+                    f"slo transition: {rec['objective']} "
+                    f"{rec['prev']} -> {rec['state']}"
+                )
+            ticks += 1
+            if max_ticks and ticks >= max_ticks:
+                break
+            time.sleep(max(0.0, watch_s))
+
+    click.echo(slo_mod.render_report(cfg, results))
+    _echo_drops(drops.count)
+    if json_out is not None:
+        payload = {
+            "exit": slo_mod.exit_code(results),
+            "results": [
+                {
+                    "objective": r.objective, "kind": r.kind,
+                    "state": r.state, "burn_short": r.burn_short,
+                    "burn_long": r.burn_long, "value": r.value,
+                    "detail": r.detail,
+                }
+                for r in results
+            ],
+        }
+        Path(json_out).parent.mkdir(parents=True, exist_ok=True)
+        Path(json_out).write_text(json.dumps(payload, indent=2))
+    if sink is not None:
+        sink.close()
+    sys.exit(slo_mod.exit_code(results))
 
 
 if __name__ == "__main__":

@@ -104,6 +104,10 @@ class RequestJournal:
             "key": [int(k) for k in np.asarray(key).reshape(-1)],
             "deadline_s": req.deadline_s,
             "kind": getattr(req, "kind", "generate"),
+            # cross-process trace context: journaled so a --replay (or a
+            # router handoff fold) reattaches the resumed stream to the
+            # SAME trace the router minted at intake
+            "trace_id": getattr(req, "trace_id", None),
             "template": (
                 None if req.template is None
                 else [int(t) for t in np.asarray(req.template).reshape(-1)]
@@ -122,15 +126,22 @@ class RequestJournal:
         })
 
     def done(self, request_id: str, status: str,
-             n_generated: int = 0) -> None:
+             n_generated: int = 0,
+             resumed_by: Optional[str] = None) -> None:
         """Terminal record: ``completed``, or a shed reason
         (``deadline_exceeded``/``draining``) — either way the request is
-        settled with its client and must never be replayed."""
-        self.emit({
+        settled with its client and must never be replayed.
+        ``resumed_by`` names the replica a ``handed_off`` request was
+        re-dispatched to, so a later ``--replay`` of THIS journal can
+        still reconstruct where the journey continued."""
+        rec = {
             "ev": "journal", "op": "done", "ts": time.time(),
             "req": str(request_id), "status": str(status),
             "n_generated": int(n_generated),
-        })
+        }
+        if resumed_by is not None:
+            rec["resumed_by"] = str(resumed_by)
+        self.emit(rec)
 
     def close(self) -> None:
         with self._lock:
@@ -241,6 +252,7 @@ def resume_request(rid: str, cls: dict) -> Request:
         kind=acc.get("kind", "generate"),
         template=None if template is None else np.asarray(template, np.int32),
         frozen=None if frozen is None else np.asarray(frozen, bool),
+        trace_id=acc.get("trace_id"),
     )
 
 

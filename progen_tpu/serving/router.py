@@ -43,7 +43,18 @@ privilege with serving/scheduler.py (PGL006). Routing decisions land
 as `{"ev": "route", "status": dispatched|handoff|shed|replica_down}`
 records (grammar owned HERE, linted by PGL006) — what `summarize`
 builds its per-replica router table from. Metrics render under the
-`progen_router_` Prometheus prefix.
+`progen_router_` Prometheus prefix, including per-replica
+`replica{i}_scrape_age_s` staleness gauges (the router used to scrape
+replicas while being a metrics blind spot itself).
+
+TRACE CONTEXT (Dapper-style, PAPERS.md): `submit()` mints a `trace_id`
+per accepted request (clients may supply their own) and every hop gets
+a per-dispatch span (`hop` counter on the `dispatched` phase records).
+The id rides the JSONL wire to the replica, is journaled on accept,
+and is carried on the resume payload after a handoff — so the replica
+tracks, the dead replica's journal, and the survivor's resumed stream
+all share ONE trace, which `telemetry/stitch.py` renders as one
+contiguous per-request journey with dispatch/handoff flow arrows.
 """
 
 from __future__ import annotations
@@ -54,6 +65,7 @@ import os
 import random
 import socket
 import time
+import uuid
 from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -164,6 +176,7 @@ class _InFlight:
     raw: dict
     tenant: Optional[str]
     t_submit: float
+    trace: str = ""
     phase: str = "queued"  # "queued" | "dispatched" (req-track phase)
     replica: Optional[int] = None
     resume: Optional[dict] = None
@@ -173,6 +186,7 @@ class _InFlight:
     n_tokens: int = 0
     text: str = ""
     first_token_t: Optional[float] = None
+    hop: int = 0  # dispatch attempts that reached a replica (span id)
 
 
 class ReplicaLink:
@@ -301,17 +315,24 @@ class Router:
         self._rng = random.Random(f"{self.policy.seed}:router")
         self._tenants: Dict[str, int] = {}
         self._last_health = -1e9
+        # trace ids must be unique across router lifetimes (a journaled
+        # trace from a previous run outlives this process), so the mint
+        # carries a per-process random tag, not just the wire sequence
+        self._trace_tag = uuid.uuid4().hex[:6]
         for fam in ("ttft_s", "latency_s"):
             self.metrics.declare_timing(fam)
 
     # ----- telemetry -------------------------------------------------------
 
     def _req_event(self, ph: str, rid: str, name: str,
-                   ts: Optional[float] = None, **attrs) -> None:
+                   ts: Optional[float] = None,
+                   trace: Optional[str] = None, **attrs) -> None:
         rec = {
             "ev": "req", "ph": ph, "name": name, "req": rid,
             "ts": time.time() if ts is None else ts,
         }
+        if trace:
+            rec["trace_id"] = trace
         if attrs:
             rec.update(attrs)
         get_telemetry().emit(rec)
@@ -328,10 +349,12 @@ class Router:
         contract, kept across the fleet)."""
         now = time.time()
         for inf in list(self.by_wire.values()):
-            self._req_event("n", inf.wire, reason, ts=now)
-            self._req_event("e", inf.wire, inf.phase, ts=now)
+            self._req_event("n", inf.wire, reason, ts=now,
+                            trace=inf.trace)
+            self._req_event("e", inf.wire, inf.phase, ts=now,
+                            trace=inf.trace)
             self._req_event("e", inf.wire, "request", ts=now,
-                            reason=reason)
+                            trace=inf.trace, reason=reason)
 
     # ----- intake ----------------------------------------------------------
 
@@ -372,18 +395,27 @@ class Router:
         # replica journal
         self._seq += 1
         wire = f"q{self._seq}-{public}"
+        # trace context: honor a client-supplied trace_id (upstream
+        # propagation), else mint one; it rides the wire, the journal,
+        # and every resume from here on
+        trace = obj.get("trace_id")
+        trace = (
+            f"t{self._trace_tag}-{self._seq}" if trace is None
+            else str(trace)
+        )
         inf = _InFlight(
             wire=wire, public=public, client=client,
-            raw={**obj, "id": wire}, tenant=tenant,
-            t_submit=self._clock(),
+            raw={**obj, "id": wire, "trace_id": trace}, tenant=tenant,
+            t_submit=self._clock(), trace=trace,
         )
         if tenant is not None:
             self._tenants[tenant] = self._tenants.get(tenant, 0) + 1
         self.pending.append(inf)
         self.by_wire[wire] = inf
         now = time.time()
-        self._req_event("b", wire, "request", ts=now, id=public)
-        self._req_event("b", wire, "queued", ts=now)
+        self._req_event("b", wire, "request", ts=now, trace=trace,
+                        id=public)
+        self._req_event("b", wire, "queued", ts=now, trace=trace)
         self.metrics.set_gauge("queue_depth", len(self.pending))
         return None
 
@@ -417,6 +449,22 @@ class Router:
                 self._replica_down(link, "connection_eof", now)
         self._dispatch_pending(now)
         self._scrape_health(now)
+        # per-replica scrape-age/staleness gauges: the router scrapes
+        # the fleet but used to be a metrics blind spot itself — age of
+        # each replica's last prom heartbeat (-1 = never scraped), its
+        # up/down bit, and the fleet-wide stale count
+        stale = 0
+        for link in self.links:
+            age = -1.0 if link.health_rx is None else now - link.health_rx
+            self.metrics.set_gauge(
+                f"replica{link.index}_scrape_age_s", age
+            )
+            self.metrics.set_gauge(
+                f"replica{link.index}_up", 1.0 if link.up else 0.0
+            )
+            if self._stale(link, now):
+                stale += 1
+        self.metrics.set_gauge("replicas_stale", stale)
         self.metrics.set_gauge(
             "replicas_up", sum(1 for link in self.links if link.up)
         )
@@ -545,13 +593,26 @@ class Router:
         inf.not_before = 0.0
         ts = time.time()
         if inf.phase == "queued":
-            self._req_event("e", inf.wire, "queued", ts=ts)
+            self._req_event("e", inf.wire, "queued", ts=ts,
+                            trace=inf.trace)
+        elif inf.phase == "dispatched":
+            # handoff fast path re-dispatches without passing through
+            # the queue: close the dead replica's hop so the track stays
+            # balanced (every b gets its e) and the journey renders as
+            # disjoint hops, not one smeared dispatch
+            self._req_event("e", inf.wire, "dispatched", ts=ts,
+                            trace=inf.trace)
+        inf.hop += 1
+        hop_attrs = {"replica": link.index, "hop": inf.hop}
+        if inf.resume is not None:
+            hop_attrs["resumed"] = True
         self._req_event("b", inf.wire, "dispatched", ts=ts,
-                        replica=link.index)
+                        trace=inf.trace, **hop_attrs)
         inf.phase = "dispatched"
         self.metrics.inc("dispatched_total")
         self._route(
             ROUTE_DISPATCHED, req=inf.public, replica=link.index,
+            trace_id=inf.trace, hop=inf.hop,
             retry=inf.retries or None,
             resumed=True if inf.resume is not None else None,
         )
@@ -569,8 +630,10 @@ class Router:
             self.metrics.inc("redispatch_retries")
         if inf.phase == "dispatched":
             ts = time.time()
-            self._req_event("e", inf.wire, "dispatched", ts=ts)
-            self._req_event("b", inf.wire, "queued", ts=ts)
+            self._req_event("e", inf.wire, "dispatched", ts=ts,
+                            trace=inf.trace)
+            self._req_event("b", inf.wire, "queued", ts=ts,
+                            trace=inf.trace)
         inf.phase = "queued"
         if front:
             self.pending.appendleft(inf)
@@ -608,7 +671,8 @@ class Router:
         if inf.first_token_t is None:
             inf.first_token_t = self._clock()
             self.metrics.observe("ttft_s", inf.first_token_t - inf.t_submit)
-            self._req_event("n", inf.wire, "first_token")
+            self._req_event("n", inf.wire, "first_token",
+                            trace=inf.trace)
         inf.last_index = index
         inf.n_tokens += 1
         inf.text += str(ev.get("text", ""))
@@ -626,8 +690,8 @@ class Router:
         latency = now - inf.t_submit
         self.metrics.observe("latency_s", latency)
         ts = time.time()
-        self._req_event("e", inf.wire, inf.phase, ts=ts)
-        self._req_event("e", inf.wire, "request", ts=ts,
+        self._req_event("e", inf.wire, inf.phase, ts=ts, trace=inf.trace)
+        self._req_event("e", inf.wire, "request", ts=ts, trace=inf.trace,
                         n_generated=inf.n_tokens)
         ev = {
             "event": "done", "id": inf.public, "text": inf.text,
@@ -647,11 +711,13 @@ class Router:
         head = reason.split(":")[0].strip().replace(" ", "_")
         self.metrics.inc(f"rejected_{head}")
         ts = time.time()
-        self._req_event("n", inf.wire, "shed", ts=ts, reason=reason)
-        self._req_event("e", inf.wire, inf.phase, ts=ts)
-        self._req_event("e", inf.wire, "request", ts=ts, reason=reason)
+        self._req_event("n", inf.wire, "shed", ts=ts, trace=inf.trace,
+                        reason=reason)
+        self._req_event("e", inf.wire, inf.phase, ts=ts, trace=inf.trace)
+        self._req_event("e", inf.wire, "request", ts=ts, trace=inf.trace,
+                        reason=reason)
         self._route(ROUTE_SHED, req=inf.public, reason=reason,
-                    replica=replica)
+                    trace_id=inf.trace or None, replica=replica)
         self._out.append((inf.client, {
             "event": "rejected", "id": inf.public, "reason": reason,
         }))
@@ -727,7 +793,7 @@ class Router:
                            replica=link.index)
                 return
             self._route(ROUTE_HANDOFF, req=inf.public, resumed=False,
-                        **{"from": link.index})
+                        trace_id=inf.trace or None, **{"from": link.index})
             self._requeue(inf, now, front=True)
             return
         # forward journaled-but-unsent tokens: written before the
@@ -750,7 +816,8 @@ class Router:
             link.inflight.pop(inf.wire, None)
             self.metrics.inc("handoff_settled")
             self._route(ROUTE_HANDOFF, req=inf.public, resumed=False,
-                        settled=True, **{"from": link.index})
+                        settled=True, trace_id=inf.trace or None,
+                        **{"from": link.index})
             self._settle(inf, now, replayed=True)
             return
         # mid-stream: fold watermarks into resume state exactly as
@@ -760,6 +827,10 @@ class Router:
 
         inf.resume = {
             "id": inf.wire,
+            # the journaled trace wins over the router's own (a resumed
+            # stream continues the trace it was accepted under; they
+            # only differ when the dead journal predates this router)
+            "trace_id": req.trace_id or inf.trace or None,
             "prime_tokens": [int(t) for t in np.asarray(req.prime).reshape(-1)],
             "length": int(req.length),
             "top_k": None if req.top_k is None else int(req.top_k),
@@ -775,13 +846,17 @@ class Router:
         # ownership mark AFTER the re-dispatch attempt: from this record
         # on the request is the router's (a restart of the dead replica
         # with --replay must skip it), whether it is already streaming
-        # on a survivor or waiting in the router's queue
+        # on a survivor or waiting in the router's queue. The mark names
+        # the resuming replica so a replay of the dead journal can still
+        # reconstruct the journey (router = still queued here).
         if marker is not None:
             for jid in cls["jids"]:
-                marker.done(jid, STATUS_HANDED_OFF, len(cls["emitted"]))
+                marker.done(jid, STATUS_HANDED_OFF, len(cls["emitted"]),
+                            resumed_by=target.name if sent else "router")
         self.metrics.inc("handoff_resumed")
         self._route(
             ROUTE_HANDOFF, req=inf.public, resumed=True,
+            trace_id=inf.trace or None,
             to=target.index if sent else None, **{"from": link.index},
         )
 

@@ -75,6 +75,11 @@ class Request:
     kind: str = "generate"
     template: object = None  # (length,) int32 or None
     frozen: object = None  # (length,) bool or None
+    # cross-process trace context (Dapper-style): minted by the router
+    # (or supplied by the client), carried over the wire, stamped on
+    # every req record and journaled on accept — a handoff resume on a
+    # survivor reattaches to the SAME trace
+    trace_id: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -149,13 +154,19 @@ class Scheduler:
     # ----- request tracing ------------------------------------------------
 
     def _req_event(self, ph: str, rid: str, name: str,
-                   ts: Optional[float] = None, **attrs) -> None:
+                   ts: Optional[float] = None,
+                   trace: Optional[str] = None, **attrs) -> None:
         """One async-lifecycle record on the process telemetry. No-op
-        cost when no sink is configured (the default in tests/bench)."""
+        cost when no sink is configured (the default in tests/bench).
+        ``trace`` is the cross-process trace context — stamped as
+        ``trace_id`` (the exact spelling PGL006 enforces) so the stitch
+        journey renderer can reattach this track to its router hop."""
         rec = {
             "ev": "req", "ph": ph, "name": name, "req": rid,
             "ts": time.time() if ts is None else ts,
         }
+        if trace is not None:
+            rec["trace_id"] = trace
         if attrs:
             rec.update(attrs)
         get_telemetry().emit(rec)
@@ -179,16 +190,18 @@ class Scheduler:
             "reason": reason,
         })
 
-    def _shed_traced(self, rid: str, reason: str,
+    def _shed_traced(self, req: Request, reason: str,
                      ts: Optional[float] = None) -> None:
         """Close an accepted-but-never-admitted request's track: the
         shed instant, then the still-open queued phase, then the
         envelope. The shed is also a journal settlement — the client
         was told 'rejected', so replay must never resurrect it."""
         ts = time.time() if ts is None else ts
-        self._req_event("n", rid, reason, ts=ts)
-        self._req_event("e", rid, "queued", ts=ts)
-        self._req_event("e", rid, "request", ts=ts, reason=reason)
+        rid, trace = req.id, req.trace_id
+        self._req_event("n", rid, reason, ts=ts, trace=trace)
+        self._req_event("e", rid, "queued", ts=ts, trace=trace)
+        self._req_event("e", rid, "request", ts=ts, trace=trace,
+                        reason=reason)
         if self.journal is not None:
             self.journal.done(rid, reason, 0)
 
@@ -201,14 +214,20 @@ class Scheduler:
         pick them up."""
         now = time.time()
         for slot in sorted(self._active):
-            rid = self._active[slot].req.id
-            self._req_event("n", rid, reason, ts=now)
-            self._req_event("e", rid, "decode", ts=now)
-            self._req_event("e", rid, "request", ts=now, reason=reason)
+            req = self._active[slot].req
+            self._req_event("n", req.id, reason, ts=now,
+                            trace=req.trace_id)
+            self._req_event("e", req.id, "decode", ts=now,
+                            trace=req.trace_id)
+            self._req_event("e", req.id, "request", ts=now,
+                            trace=req.trace_id, reason=reason)
         for req, _ in self._queue:
-            self._req_event("n", req.id, reason, ts=now)
-            self._req_event("e", req.id, "queued", ts=now)
-            self._req_event("e", req.id, "request", ts=now, reason=reason)
+            self._req_event("n", req.id, reason, ts=now,
+                            trace=req.trace_id)
+            self._req_event("e", req.id, "queued", ts=now,
+                            trace=req.trace_id)
+            self._req_event("e", req.id, "request", ts=now,
+                            trace=req.trace_id, reason=reason)
 
     # ----- intake ---------------------------------------------------------
 
@@ -256,8 +275,9 @@ class Scheduler:
         self.metrics.set_gauge("queue_depth", len(self._queue))
         now = time.time()
         self._req_event("b", req.id, "request", ts=now,
-                        length=int(req.length))
-        self._req_event("b", req.id, "queued", ts=now)
+                        trace=req.trace_id, length=int(req.length))
+        self._req_event("b", req.id, "queued", ts=now,
+                        trace=req.trace_id)
         if self.journal is not None:
             # durable before acknowledged: once the caller sees True,
             # the request survives any kill via --replay
@@ -295,7 +315,7 @@ class Scheduler:
                 self.metrics.inc("requests_rejected")
                 self.metrics.inc("rejected_deadline_exceeded")
                 self._expired.append((req, REJECT_DEADLINE))
-                self._shed_traced(req.id, REJECT_DEADLINE)
+                self._shed_traced(req, REJECT_DEADLINE)
             else:
                 kept.append((req, t_submit))
         self._queue = kept
@@ -318,7 +338,7 @@ class Scheduler:
             self.metrics.inc("requests_rejected")
             self.metrics.inc(f"rejected_{reason}")
             self._expired.append((req, reason))
-            self._shed_traced(req.id, reason)
+            self._shed_traced(req, reason)
         self.metrics.set_gauge("queue_depth", 0)
         return n
 
@@ -329,14 +349,15 @@ class Scheduler:
         FIFO with generation (an embed behind a queued generate waits its
         turn, same as a slot would)."""
         w0 = time.time()
-        self._req_event("e", req.id, "queued", ts=w0)
-        self._req_event("b", req.id, "embed", ts=w0)
+        self._req_event("e", req.id, "queued", ts=w0, trace=req.trace_id)
+        self._req_event("b", req.id, "embed", ts=w0, trace=req.trace_id)
         t0 = self._clock()
         vec = self.engine.embed(req.prime, add_bos=req.add_bos)
         t1 = self._clock()
         w1 = time.time()
-        self._req_event("e", req.id, "embed", ts=w1)
-        self._req_event("e", req.id, "request", ts=w1, dim=int(vec.shape[0]))
+        self._req_event("e", req.id, "embed", ts=w1, trace=req.trace_id)
+        self._req_event("e", req.id, "request", ts=w1, trace=req.trace_id,
+                        dim=int(vec.shape[0]))
         self.metrics.inc("embed_requests")
         self.metrics.add_time("embed_time_s", t1 - t0)
         self.metrics.observe("latency_s", t1 - t_submit)
@@ -364,8 +385,10 @@ class Scheduler:
                 break
             req, t_submit = self._queue.popleft()
             w0 = time.time()
-            self._req_event("e", req.id, "queued", ts=w0)
-            self._req_event("b", req.id, "prefill", ts=w0, slot=slot)
+            self._req_event("e", req.id, "queued", ts=w0,
+                            trace=req.trace_id)
+            self._req_event("b", req.id, "prefill", ts=w0,
+                            trace=req.trace_id, slot=slot)
             t0 = self._clock()
             start = self.engine.prefill(
                 slot, req.prime, req.length, top_k=req.top_k,
@@ -376,8 +399,10 @@ class Scheduler:
             )
             t1 = self._clock()
             w1 = time.time()
-            self._req_event("e", req.id, "prefill", ts=w1)
-            self._req_event("b", req.id, "decode", ts=w1, slot=slot)
+            self._req_event("e", req.id, "prefill", ts=w1,
+                            trace=req.trace_id)
+            self._req_event("b", req.id, "decode", ts=w1,
+                            trace=req.trace_id, slot=slot)
             self._active[slot] = _Active(req, slot, start, t_submit, t1)
             self.metrics.inc("requests_admitted")
             # start-1 prime tokens actually ran through the model
@@ -419,7 +444,8 @@ class Scheduler:
             if rec.first_token_t is None:
                 rec.first_token_t = now
                 self.metrics.observe("ttft_s", now - rec.t_submit)
-                self._req_event("n", rec.req.id, "first_token")
+                self._req_event("n", rec.req.id, "first_token",
+                                trace=rec.req.trace_id)
             else:
                 # inter-token latency: gap between consecutive tokens
                 # of THIS request (== decode-step period while the slot
@@ -459,8 +485,10 @@ class Scheduler:
         self.metrics.inc("requests_completed")
         self.metrics.observe("latency_s", now - rec.t_submit)
         done_t = time.time()
-        self._req_event("e", rec.req.id, "decode", ts=done_t)
+        self._req_event("e", rec.req.id, "decode", ts=done_t,
+                        trace=rec.req.trace_id)
         self._req_event("e", rec.req.id, "request", ts=done_t,
+                        trace=rec.req.trace_id,
                         n_generated=rec.n_generated)
         self._emit_slots()
         return Completion(

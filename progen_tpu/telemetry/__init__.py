@@ -28,7 +28,11 @@ hung or slow run into a one-line diagnosis (MegaScale NSDI'24, Dapper
     — MegaScale-style per-host goodput + straggler skew table;
   * ``stitch_trace`` / ``clock_offsets`` / ``emit_clock_beacon`` —
     N hosts' event files → ONE fleet trace on a common corrected clock
-    (the ``telemetry stitch`` CLI), beacon-anchored skew correction.
+    (the ``telemetry stitch`` CLI), beacon-anchored skew correction,
+    plus per-request journey flows across router → replica → survivor;
+  * ``slo`` — the fleet SLO watchtower: objectives from TOML,
+    multi-window burn rates over metrics.jsonl / Prometheus textfiles,
+    ``ev: "slo"`` transition records, and the slo-report CI gate.
 
 Everything is CPU-testable; nothing here imports jax at module scope.
 """
@@ -47,6 +51,13 @@ from progen_tpu.telemetry.prometheus import (
     write_prometheus,
 )
 from progen_tpu.telemetry.registry import MetricsRegistry, get_registry
+from progen_tpu.telemetry.slo import (
+    SloConfig,
+    SloWatch,
+    evaluate as evaluate_slos,
+    exit_code as slo_exit_code,
+    load_objectives,
+)
 from progen_tpu.telemetry.spans import (
     EventLog,
     Telemetry,
@@ -91,4 +102,9 @@ __all__ = [
     "emit_clock_beacon",
     "stitch_streams",
     "stitch_trace",
+    "SloConfig",
+    "SloWatch",
+    "evaluate_slos",
+    "slo_exit_code",
+    "load_objectives",
 ]

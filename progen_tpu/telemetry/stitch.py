@@ -27,9 +27,22 @@ The stitched trace additionally gets:
     renders as a visible arrow fan tilting toward it;
   * the fleet-wide ``progenGoodputSkew`` table (every host's
     ``goodput_host`` record is in the merged stream, deduped);
+  * per-request JOURNEYS: ``req`` records carrying a ``trace_id``
+    (router intake, replica lifecycle — serving/router.py mints the id,
+    the wire carries it) are grouped per trace and linked with
+    ``dispatch``/``handoff`` flow arrows from each router dispatch hop
+    into the replica-side track it started — a midstream replica death
+    renders as ONE contiguous journey: router queued → dispatch arrow →
+    dead replica's partial decode → handoff arrow → the survivor's
+    resumed track. The per-trace table rides along as ``progenTraces``;
   * ``progenClockOffsets`` (seconds subtracted per host) and
     ``progenDroppedLines`` (torn/garbage input lines) as top-level
     keys — trace viewers ignore unknown keys.
+
+A serving fleet is N processes on (usually) one machine, all stamping
+``pid`` 0 — ``force_hosts=True`` (CLI ``--force-hosts``) re-stamps each
+stream with its argument position so router and replicas get distinct
+process tracks (required for the journey arrows to have two ends).
 """
 
 from __future__ import annotations
@@ -125,10 +138,104 @@ def stream_host(records: Sequence[dict], default: int = 0) -> int:
     return max(votes, key=lambda h: (votes[h], -h))
 
 
+# a dispatch arrow binds to the first replica-side request begin at or
+# after the router's dispatch instant; the slack absorbs same-host
+# scheduling jitter between the router's send and the replica's accept
+_DISPATCH_SLACK_S = 0.005
+
+
+def request_journeys(
+    merged: Sequence[dict],
+) -> Tuple[List[dict], Dict[str, dict]]:
+    """Per-trace journey flows from corrected ``req`` records.
+
+    Groups records by ``trace_id``, then pairs the router's k-th
+    ``dispatched`` begin with the earliest unconsumed replica-side
+    ``request`` begin at ts >= dispatch − slack, emitting one
+    ``s``/``f`` flow arrow per hop (named ``handoff`` when the dispatch
+    was a journal-ownership resume, ``dispatch`` otherwise). Returns
+    (flow events, per-trace table for ``progenTraces``). The router pid
+    is wherever the ``dispatched`` phases live — replica begins on that
+    pid are the router's own envelope, not a hop target."""
+    per: Dict[str, dict] = {}
+    for rec in merged:
+        if rec.get("ev") != "req":
+            continue
+        tr = rec.get("trace_id")
+        ts = rec.get("ts")
+        if tr is None or ts is None:
+            continue
+        j = per.setdefault(str(tr), {
+            "dispatches": [], "begins": [], "pids": set(), "sheds": 0,
+        })
+        j["pids"].add(int(rec.get("pid", 0)))
+        name = rec.get("name")
+        ph = rec.get("ph")
+        if ph == "b" and name == "dispatched":
+            j["dispatches"].append({
+                "ts": float(ts), "pid": int(rec.get("pid", 0)),
+                "resumed": bool(rec.get("resumed")),
+            })
+        elif ph == "b" and name == "request":
+            j["begins"].append(
+                {"ts": float(ts), "pid": int(rec.get("pid", 0))}
+            )
+        elif ph == "n" and name == "shed":
+            j["sheds"] += 1
+
+    flows: List[dict] = []
+    table: Dict[str, dict] = {}
+    for tr in sorted(per):
+        j = per[tr]
+        dispatches = sorted(j["dispatches"], key=lambda d: d["ts"])
+        router_pid = dispatches[0]["pid"] if dispatches else None
+        begins = sorted(
+            (b for b in j["begins"] if b["pid"] != router_pid),
+            key=lambda b: b["ts"],
+        )
+        used = [False] * len(begins)
+        arrows = 0
+        handoffs = 0
+        for k, d in enumerate(dispatches):
+            target = None
+            for i, b in enumerate(begins):
+                if not used[i] and b["ts"] >= d["ts"] - _DISPATCH_SLACK_S:
+                    target = i
+                    break
+            if target is None:
+                continue
+            used[target] = True
+            b = begins[target]
+            name = "handoff" if d["resumed"] else "dispatch"
+            fid = f"trace:{tr}:{k}"
+            flows.append({
+                "ph": "s", "cat": "request_flow", "name": name,
+                "id": fid, "ts": d["ts"] * 1e6, "pid": d["pid"],
+                "tid": 0,
+            })
+            flows.append({
+                "ph": "f", "bp": "e", "cat": "request_flow",
+                "name": name, "id": fid, "ts": b["ts"] * 1e6,
+                "pid": b["pid"], "tid": 0,
+            })
+            arrows += 1
+            if d["resumed"]:
+                handoffs += 1
+        table[tr] = {
+            "pids": sorted(j["pids"]),
+            "hops": len(dispatches),
+            "handoffs": handoffs,
+            "flows": arrows,
+            "shed": j["sheds"] > 0,
+        }
+    return flows, table
+
+
 def stitch_streams(
     event_streams: Sequence[Sequence[dict]],
     metrics_streams: Sequence[Tuple[int, Sequence[dict]]] = (),
     reference: int = 0,
+    force_hosts: bool = False,
 ) -> dict:
     """Merge already-parsed per-host record streams into one trace dict.
 
@@ -138,8 +245,16 @@ def stitch_streams(
     records are deduped across streams (each host's own copy wins) so
     the fleet skew table counts every host exactly once.
     ``metrics_streams`` pairs each row set with the host it came from —
-    metrics.jsonl rows carry no pid of their own."""
+    metrics.jsonl rows carry no pid of their own. ``force_hosts``
+    re-stamps stream ``i`` with pid ``i`` regardless of what the records
+    say — the serving fleet is N processes on one host, all stamping
+    pid 0, and the journey flow arrows need distinct tracks."""
     streams = [list(s) for s in event_streams]
+    if force_hosts:
+        streams = [
+            [{**rec, "pid": i} for rec in stream]
+            for i, stream in enumerate(streams)
+        ]
     beacons = collect_beacons(r for s in streams for r in s)
     offsets = clock_offsets(beacons, reference=reference)
 
@@ -216,6 +331,9 @@ def stitch_streams(
             })
             arrows += 1
 
+    journey_flows, journeys = request_journeys(merged)
+    extra.extend(journey_flows)
+
     meta = [e for e in trace["traceEvents"] if e["ph"] == "M"]
     timed = [e for e in trace["traceEvents"] if e["ph"] != "M"] + extra
     timed.sort(key=lambda e: e["ts"])  # stable: file order at equal ts
@@ -223,10 +341,13 @@ def stitch_streams(
     trace["progenClockOffsets"] = {
         str(h): round(off, 6) for h, off in sorted(offsets.items())
     }
+    if journeys:
+        trace["progenTraces"] = journeys
     trace["progenStitch"] = {
         "hosts": len(streams),
         "beacon_steps": len(steps),
         "flow_arrows": arrows,
+        "request_flows": len(journey_flows) // 2,
     }
     return trace
 
@@ -236,20 +357,27 @@ def stitch_trace(
     out_path=None,
     metrics_paths: Sequence = (),
     reference: int = 0,
+    force_hosts: bool = False,
 ) -> dict:
     """File-level stitch: read N hosts' events.jsonl (and optionally
     their metrics.jsonl, zipped positionally with ``event_paths``),
     merge onto the reference host's clock, optionally write the trace
-    JSON, and return the trace dict."""
+    JSON, and return the trace dict. ``force_hosts`` assigns each file
+    its argument position as its pid (serving fleets share a host, so
+    every process stamps pid 0 — indistinguishable tracks otherwise)."""
     drops = LineDrops()
     streams = [list(iter_jsonl(p, drops)) for p in event_paths]
-    hosts = [stream_host(s, i) for i, s in enumerate(streams)]
+    if force_hosts:
+        hosts = list(range(len(streams)))
+    else:
+        hosts = [stream_host(s, i) for i, s in enumerate(streams)]
     metrics_streams: List[Tuple[int, List[dict]]] = []
     for host, mp in zip(hosts, metrics_paths or ()):
         if mp is not None and Path(mp).exists():
             metrics_streams.append((host, list(iter_jsonl(mp, drops))))
     trace = stitch_streams(
-        streams, metrics_streams, reference=reference
+        streams, metrics_streams, reference=reference,
+        force_hosts=force_hosts,
     )
     trace["progenDroppedLines"] = drops.count
     if out_path is not None:

@@ -55,3 +55,10 @@ def clean_score_metrics(reg):
     # are restricted to progen_tpu/workloads/
     reg.inc("sequences_scored", 8)
     reg.set_gauge("goodput_pct", 91.0)
+
+
+def clean_slo_metrics(reg):
+    # SLO-adjacent METRICS are fine anywhere — only raw ev:"slo"
+    # transition records are restricted to telemetry/slo.py
+    reg.set_gauge("slo_burn_rate", 0.4)
+    reg.inc("slo_transitions")

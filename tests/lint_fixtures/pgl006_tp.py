@@ -1,4 +1,4 @@
-"""PGL006 true positives: telemetry hygiene. Expected findings: 17."""
+"""PGL006 true positives: telemetry hygiene. Expected findings: 20."""
 
 
 def unbounded_span(telemetry, name):
@@ -15,8 +15,10 @@ def slash_metric(reg):
 
 
 def raw_req_record(emit):
-    # TP: async req record outside serving/scheduler.py
-    emit({"ev": "req", "ph": "b", "name": "queued", "req": "r1"})
+    # TP x2: async req record outside serving/scheduler.py AND a
+    # misspelled trace-context key (the blessed spelling is trace_id)
+    emit({"ev": "req", "ph": "b", "name": "queued", "req": "r1",
+          "trace": "t1"})
 
 
 def bad_async_ph(emit):
@@ -61,3 +63,9 @@ def bad_score_op(emit):
     # TP x2: outside workloads/ AND an op outside the
     # start/resume/batch/skip/done scoring alphabet
     emit({"ev": "score", "op": "progress", "n": 4})
+
+
+def bad_slo_state(emit):
+    # TP x2: slo record outside telemetry/slo.py AND a state outside
+    # the ok/warn/burning/resolved transition alphabet
+    emit({"ev": "slo", "objective": "ttft_p95", "state": "melting"})

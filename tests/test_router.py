@@ -558,3 +558,118 @@ class TestFailover:
             elif r["ph"] == "e":
                 opens.pop((r["req"], r["name"]), None)
         assert opens == {}
+
+
+class TestTraceContext:
+    """Dapper-style trace propagation: the router mints one trace_id
+    per accepted request, the wire carries it to replicas, the journal
+    persists it, and a handoff resume reattaches to the SAME trace."""
+
+    def test_trace_minted_and_on_every_record(self, tmp_path,
+                                              telemetry_records):
+        rep = FakeReplica(tmp_path, "r0")
+        router = make_router([rep])
+        try:
+            router.submit({"id": "a", "prime": "M", "length": 8})
+            pump(router, [rep])
+            wire_req = rep.requests[0]
+            trace = wire_req.get("trace_id")
+            assert trace  # minted, and carried on the wire
+            rep.send({"event": "done", "id": wire_req["id"],
+                      "text": "", "n_generated": 0})
+            pump(router, [rep])
+        finally:
+            rep.close()
+        reqs = [r for r in telemetry_records if r.get("ev") == "req"]
+        assert reqs
+        assert {r.get("trace_id") for r in reqs} == {trace}
+        dispatched = [r for r in telemetry_records
+                      if r.get("ev") == "route"
+                      and r["status"] == ROUTE_DISPATCHED]
+        assert dispatched[0]["trace_id"] == trace
+        assert dispatched[0]["hop"] == 1
+
+    def test_client_supplied_trace_honored(self, tmp_path):
+        rep = FakeReplica(tmp_path, "r0")
+        router = make_router([rep])
+        try:
+            router.submit({"id": "a", "prime": "M", "length": 8,
+                           "trace_id": "upstream-7"})
+            pump(router, [rep])
+            assert rep.requests[0]["trace_id"] == "upstream-7"
+        finally:
+            rep.close()
+
+    def test_traces_unique_across_requests(self, tmp_path):
+        rep = FakeReplica(tmp_path, "r0")
+        router = make_router([rep])
+        try:
+            router.submit({"id": "a", "prime": "M", "length": 8})
+            router.submit({"id": "b", "prime": "M", "length": 8})
+            pump(router, [rep])
+            traces = {r["trace_id"] for r in rep.requests}
+            assert len(traces) == 2
+        finally:
+            rep.close()
+
+    def test_handoff_resume_keeps_trace_and_marks_resumer(
+            self, tmp_path, telemetry_records):
+        """The acceptance bar: a midstream replica death must NOT fork
+        the trace — the journaled accept carries the trace_id, the
+        resume payload restores it, and the handed_off ownership mark
+        names the resuming replica."""
+        j0 = tmp_path / "j0"
+        r0 = FakeReplica(tmp_path, "r0", journal_dir=j0)
+        r1 = FakeReplica(tmp_path, "r1")
+        router = make_router([r0, r1])
+        try:
+            router.submit({"id": "a", "prime": "MK", "length": 10,
+                           "seed": 7})
+            pump(router, [r0])
+            wire_req = r0.requests[0]
+            wire = wire_req["id"]
+            trace = wire_req["trace_id"]
+            # the replica journals the accept exactly as serve does:
+            # the Request built from the wire dict carries the trace
+            jr = RequestJournal(j0 / "journal.jsonl")
+            jid = f"9:{wire}"
+            jr.accept(Request(
+                id=jid, prime=np.asarray([5, 6], np.int32), length=10,
+                add_bos=True, seed=7, trace_id=trace,
+            ))
+            jr.token(jid, 3, 41)
+            jr.close()
+            accepts = [
+                json.loads(ln) for ln in
+                (j0 / "journal.jsonl").read_text().splitlines()
+                if json.loads(ln).get("op") == "accept"
+            ]
+            assert accepts[0]["trace_id"] == trace
+            r0.die()
+            deadline = time.monotonic() + 2.0
+            while not r1.requests:
+                pump(router, [r1], rounds=1)
+                assert time.monotonic() < deadline, "no handoff"
+                time.sleep(0.005)
+            # the resume payload reattaches to the SAME trace
+            assert r1.requests[0]["id"] == wire
+            assert r1.requests[0]["trace_id"] == trace
+            # the ownership mark names who resumed the stream
+            marks = [
+                json.loads(ln) for ln in
+                (j0 / "journal.jsonl").read_text().splitlines()
+                if json.loads(ln).get("op") == "done"
+            ]
+            assert marks[0]["status"] == STATUS_HANDED_OFF
+            assert marks[0]["resumed_by"]
+        finally:
+            r0.close()
+            r1.close()
+        # router-side: ONE trace across both dispatch hops, the second
+        # hop flagged as a resume
+        reqs = [r for r in telemetry_records if r.get("ev") == "req"]
+        assert {r.get("trace_id") for r in reqs} == {trace}
+        hops = [r for r in reqs
+                if r.get("ph") == "b" and r.get("name") == "dispatched"]
+        assert [h["hop"] for h in hops] == [1, 2]
+        assert hops[1].get("resumed") is True

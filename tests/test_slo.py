@@ -1,0 +1,444 @@
+"""Fleet SLO watchtower (telemetry/slo.py): burn-rate math on synthetic
+series, window-edge behavior, torn/stale exposition files, the
+transition-record state machine, and the slo-report CLI's exit-code
+contract (0 ok / 1 warn / 2 burning)."""
+
+import json
+import os
+import time
+
+import pytest
+from click.testing import CliRunner
+
+from progen_tpu.cli.telemetry import main as telemetry_cli
+from progen_tpu.telemetry.slo import (
+    STATE_BURNING,
+    STATE_OK,
+    STATE_RESOLVED,
+    STATE_WARN,
+    Objective,
+    SloConfig,
+    SloWatch,
+    evaluate,
+    exit_code,
+    load_objectives,
+    parse_prom_text,
+    read_prom_file,
+    render_report,
+    samples_from_metrics,
+)
+
+OBJECTIVES_TOML = """
+[windows]
+short_s = 60
+long_s = 600
+
+[burn]
+warn = 1.0
+hot = 2.0
+stale_after_s = 30
+
+[objective_ttft_p95]
+kind = "latency"
+metric = "ttft_s"
+quantile = "p95"
+threshold_s = 1.0
+
+[objective_error_rate]
+kind = "ratio"
+bad = "requests_rejected"
+total = "requests_submitted"
+budget = 0.1
+
+[objective_availability]
+kind = "availability"
+gauge = "replicas_up"
+min_value = 2.0
+target = 0.9
+"""
+
+
+@pytest.fixture
+def cfg(tmp_path):
+    p = tmp_path / "slo.toml"
+    p.write_text(OBJECTIVES_TOML)
+    return load_objectives(p)
+
+
+def rows(points):
+    """(t, submitted, rejected, up, ttft_p95) tuples → metrics.jsonl
+    rows in the tracker's router/ stream shape."""
+    return [
+        {
+            "_time": t,
+            "router/requests_submitted": float(sub),
+            "router/requests_rejected": float(rej),
+            "router/replicas_up": float(up),
+            "router/ttft_s_p95_s": float(ttft),
+        }
+        for t, sub, rej, up, ttft in points
+    ]
+
+
+def series_for(points):
+    return [samples_from_metrics(rows(points))]
+
+
+def by_name(results):
+    return {r.objective: r for r in results}
+
+
+class TestTomlLoading:
+    def test_shipped_default_parses(self):
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        cfg = load_objectives(
+            os.path.join(repo, "configs", "serving", "slo.toml")
+        )
+        kinds = {o.name: o.kind for o in cfg.objectives}
+        assert kinds == {
+            "ttft_p95": "latency", "latency_p99": "latency",
+            "error_rate": "ratio", "availability": "availability",
+        }
+
+    def test_windows_and_thresholds(self, cfg):
+        assert cfg.short_s == 60 and cfg.long_s == 600
+        assert cfg.warn == 1.0 and cfg.hot == 2.0
+        assert cfg.stale_after_s == 30
+
+    def test_unknown_kind_rejected(self, tmp_path):
+        p = tmp_path / "bad.toml"
+        p.write_text(
+            "[objective_x]\nkind = \"throughput\"\n"
+        )
+        with pytest.raises(ValueError, match="unknown kind"):
+            load_objectives(p)
+
+    def test_bad_quantile_rejected(self, tmp_path):
+        p = tmp_path / "bad.toml"
+        p.write_text(
+            "[objective_x]\nkind = \"latency\"\nmetric = \"ttft_s\"\n"
+            "quantile = \"p42\"\n"
+        )
+        with pytest.raises(ValueError, match="p42"):
+            load_objectives(p)
+
+    def test_empty_rejected(self, tmp_path):
+        p = tmp_path / "empty.toml"
+        p.write_text("[windows]\nshort_s = 60\n")
+        with pytest.raises(ValueError, match="no .objective"):
+            load_objectives(p)
+
+
+class TestPromParsing:
+    def test_counters_gauges_quantiles_normalized(self):
+        text = (
+            "# TYPE progen_router_requests_submitted_total counter\n"
+            "progen_router_requests_submitted_total 10\n"
+            "# TYPE progen_router_replicas_up gauge\n"
+            "progen_router_replicas_up 2\n"
+            "# TYPE progen_serve_ttft_seconds summary\n"
+            'progen_serve_ttft_seconds{quantile="0.95"} 0.5\n'
+            "progen_serve_ttft_seconds_sum 1.25\n"
+            "progen_serve_ttft_seconds_count 4\n"
+        )
+        got = parse_prom_text(text)
+        assert got == {
+            "requests_submitted": 10.0,
+            "replicas_up": 2.0,
+            "ttft_s_p95_s": 0.5,
+            "ttft_s_sum": 1.25,
+            "ttft_s_count": 4.0,
+        }
+
+    def test_torn_lines_skipped_never_fatal(self):
+        text = (
+            "progen_router_replicas_up 2\n"
+            "progen_router_requests_submi"  # torn mid-write
+        )
+        assert parse_prom_text(text) == {"replicas_up": 2.0}
+        assert parse_prom_text("!!! garbage\n\x00\n") == {}
+        assert parse_prom_text("progen_router_x notanumber\n") == {}
+
+    def test_read_prom_file_age_and_missing(self, tmp_path):
+        p = tmp_path / "m.prom"
+        p.write_text("progen_router_replicas_up 2\n")
+        old = time.time() - 120
+        os.utime(p, (old, old))
+        age, vals = read_prom_file(p)
+        assert 115 < age < 130
+        assert vals == {"replicas_up": 2.0}
+        assert read_prom_file(tmp_path / "gone.prom") is None
+
+
+class TestSamplesFromMetrics:
+    def test_prefix_stripped_and_sorted(self):
+        out = samples_from_metrics([
+            {"_time": 2.0, "serve/ttft_s_p95_s": 0.2},
+            {"_time": 1.0, "router/replicas_up": 2, "_step": 3,
+             "note": "strings dropped"},
+            {"no_time": True},
+        ])
+        assert out == [
+            (1.0, {"replicas_up": 2.0}),
+            (2.0, {"ttft_s_p95_s": 0.2}),
+        ]
+
+
+class TestBurnRates:
+    def test_all_healthy_exit_zero(self, cfg):
+        pts = [(t, 10 * t, 0, 2, 0.3) for t in range(1, 20)]
+        res = evaluate(cfg, series_for(pts))
+        assert {r.state for r in res} == {STATE_OK}
+        assert exit_code(res) == 0
+
+    def test_latency_burn_is_value_over_threshold(self, cfg):
+        pts = [(100.0, 10, 0, 2, 0.5)]
+        r = by_name(evaluate(cfg, series_for(pts)))["ttft_p95"]
+        assert r.burn_short == pytest.approx(0.5)
+        assert r.state == STATE_OK
+        pts = [(100.0, 10, 0, 2, 1.5)]
+        r = by_name(evaluate(cfg, series_for(pts)))["ttft_p95"]
+        assert r.burn_short == pytest.approx(1.5)
+        assert r.state == STATE_WARN
+        pts = [(100.0, 10, 0, 2, 2.5)]
+        r = by_name(evaluate(cfg, series_for(pts)))["ttft_p95"]
+        assert r.state == STATE_BURNING
+
+    def test_ratio_windowed_delta(self, cfg):
+        # old samples: 50% rejected — but all outside both windows'
+        # deltas (counters flat since); windows judge the RECENT burn
+        pts = [
+            (0.0, 100, 50, 2, 0.1),
+            (500.0, 100, 50, 2, 0.1),
+            (1000.0, 200, 50, 2, 0.1),  # 100 new, 0 rejected
+        ]
+        r = by_name(evaluate(cfg, series_for(pts)))["error_rate"]
+        assert r.burn_long == pytest.approx(0.0)
+        assert r.state == STATE_OK
+
+    def test_ratio_fast_burn_both_windows_pages(self, cfg):
+        # half of recent requests rejected against a 10% budget → both
+        # windows far over hot → burning → exit 2
+        pts = [
+            (940.0, 100, 0, 2, 0.1),
+            (990.0, 200, 50, 2, 0.1),
+            (1000.0, 300, 100, 2, 0.1),
+        ]
+        res = evaluate(cfg, series_for(pts))
+        r = by_name(res)["error_rate"]
+        # short window [940, 1000]: 100 rejected of 200 new → burn 5
+        assert r.burn_short == pytest.approx(5.0)
+        # long window [400, 1000]: 100 of 300 → burn 10/3
+        assert r.burn_long == pytest.approx(10.0 / 3.0)
+        assert r.state == STATE_BURNING
+        assert exit_code(res) == 2
+
+    def test_ratio_slow_burn_warns_not_pages(self, cfg):
+        # long window over budget, short window clean → warn, not page
+        pts = [
+            (400.0, 100, 0, 2, 0.1),
+            (500.0, 200, 25, 2, 0.1),   # the incident, long ago
+            (1000.0, 300, 25, 2, 0.1),  # short window: clean
+        ]
+        res = evaluate(cfg, series_for(pts))
+        r = by_name(res)["error_rate"]
+        assert r.burn_short == pytest.approx(0.0)
+        # 25 rejected of 200 new in [400, 1000] → 0.125/0.1 budget
+        assert r.burn_long == pytest.approx(1.25)
+        assert r.state == STATE_WARN
+        assert exit_code(res) == 1
+
+    def test_counter_reset_not_negative(self, cfg):
+        # process restart mid-window: counters drop to near zero; the
+        # delta must fall back to the post-restart value, never negative
+        pts = [
+            (900.0, 1000, 100, 2, 0.1),
+            (950.0, 20, 10, 2, 0.1),   # restarted
+            (1000.0, 40, 10, 2, 0.1),
+        ]
+        r = by_name(evaluate(cfg, series_for(pts)))["error_rate"]
+        assert r.burn_short is not None and r.burn_short >= 0.0
+
+    def test_availability_burn(self, cfg):
+        # half the window samples below min replicas vs a 90% target →
+        # burn 5 on both windows → burning
+        pts = [(1000.0 + i, 10, 0, (2 if i % 2 else 1), 0.1)
+               for i in range(20)]
+        r = by_name(evaluate(cfg, series_for(pts)))["availability"]
+        assert r.burn_long == pytest.approx(5.0)
+        assert r.state == STATE_BURNING
+
+    def test_window_edge_sample_exactly_at_boundary(self, cfg):
+        # a sample exactly at now-short_s is IN the short window
+        pts = [(940.0, 100, 0, 1, 0.1), (1000.0, 100, 0, 2, 0.1)]
+        r = by_name(
+            evaluate(cfg, series_for(pts), now=1000.0)
+        )["availability"]
+        # 1 of 2 in-window samples below min → burn (0.5)/(0.1) = 5
+        assert r.burn_short == pytest.approx(5.0)
+
+    def test_no_data_is_warn_not_ok(self, cfg):
+        res = evaluate(cfg, [])
+        assert {r.state for r in res} == {STATE_WARN}
+        assert exit_code(res) == 1
+
+    def test_latency_from_fresh_prom_overrides_nothing_stale(self, cfg):
+        proms = [(5.0, {"ttft_s_p95_s": 2.5})]  # fresh, hot
+        r = by_name(evaluate(cfg, [], proms=proms))["ttft_p95"]
+        assert r.state == STATE_BURNING
+
+    def test_stale_prom_is_warn(self, cfg):
+        # the ONLY evidence is an expired textfile → liveness problem
+        proms = [(120.0, {"ttft_s_p95_s": 0.1})]  # stale (>30s)
+        r = by_name(evaluate(cfg, [], proms=proms))["ttft_p95"]
+        assert r.state == STATE_WARN
+        assert "stale" in r.detail
+
+    def test_worst_source_wins_latency(self, cfg):
+        proms = [(1.0, {"ttft_s_p95_s": 0.2}),
+                 (1.0, {"ttft_s_p95_s": 0.9})]
+        r = by_name(evaluate(cfg, [], proms=proms))["ttft_p95"]
+        assert r.value == pytest.approx(0.9)
+
+    def test_report_mode_now_defaults_to_newest_sample(self, cfg):
+        # deterministic over archived artifacts: wall clock must not
+        # leak in (these timestamps are years in the "past")
+        pts = [(100.0 + i, 10 * i, 0, 2, 0.2) for i in range(10)]
+        a = evaluate(cfg, series_for(pts))
+        b = evaluate(cfg, series_for(pts))
+        assert [(r.state, r.burn_long) for r in a] == \
+               [(r.state, r.burn_long) for r in b]
+        assert by_name(a)["availability"].state == STATE_OK
+
+
+class TestSloWatch:
+    def test_transitions_only_and_resolved(self, cfg):
+        recs = []
+        watch = SloWatch(cfg, emit=recs.append)
+        burning = evaluate(cfg, series_for(
+            [(990.0, 100, 0, 2, 0.1), (1000.0, 200, 100, 2, 0.1)]
+        ))
+        ok = evaluate(cfg, series_for(
+            [(t, 10 * t, 0, 2, 0.1) for t in range(980, 1001)]
+        ))
+        watch.observe(ok, now=1.0)      # starts assumed ok: no records
+        assert recs == []
+        watch.observe(burning, now=2.0)
+        watch.observe(burning, now=3.0)  # steady state: no repeat spam
+        n_after_burn = len(recs)
+        watch.observe(ok, now=4.0)
+        assert n_after_burn == len(
+            [r for r in recs if r["state"] != STATE_RESOLVED]
+        )
+        err = [r for r in recs if r["objective"] == "error_rate"]
+        assert [r["state"] for r in err] == [
+            STATE_BURNING, STATE_RESOLVED
+        ]
+        assert err[0]["prev"] == STATE_OK
+        assert err[1]["prev"] == STATE_BURNING
+        for r in recs:
+            assert r["ev"] == "slo"
+
+    def test_render_report_mentions_gate(self, cfg):
+        res = evaluate(cfg, [])
+        text = render_report(cfg, res)
+        assert "gate: exit 1" in text
+        assert "ttft_p95" in text
+
+
+class TestSloReportCli:
+    def _metrics_file(self, tmp_path, pts, name="metrics.jsonl"):
+        p = tmp_path / name
+        with p.open("w") as f:
+            for row in rows(pts):
+                f.write(json.dumps(row) + "\n")
+        return p
+
+    def _objectives(self, tmp_path):
+        p = tmp_path / "slo.toml"
+        p.write_text(OBJECTIVES_TOML)
+        return p
+
+    def test_clean_run_exits_zero(self, tmp_path):
+        m = self._metrics_file(
+            tmp_path, [(t, 10 * t, 0, 2, 0.3) for t in range(1, 20)]
+        )
+        res = CliRunner().invoke(telemetry_cli, [
+            "slo-report", "--objectives", str(self._objectives(tmp_path)),
+            "--metrics", str(m),
+        ])
+        assert res.exit_code == 0, res.output
+        assert "gate: exit 0" in res.output
+
+    def test_burning_run_exits_two_and_writes_artifacts(self, tmp_path):
+        m = self._metrics_file(tmp_path, [
+            (990.0, 100, 0, 1, 0.1), (1000.0, 200, 100, 1, 0.1),
+        ])
+        events = tmp_path / "slo_events.jsonl"
+        out = tmp_path / "slo.json"
+        res = CliRunner().invoke(telemetry_cli, [
+            "slo-report", "--objectives", str(self._objectives(tmp_path)),
+            "--metrics", str(m), "--events-out", str(events),
+            "--json", str(out),
+        ])
+        assert res.exit_code == 2, res.output
+        payload = json.loads(out.read_text())
+        assert payload["exit"] == 2
+        states = {r["objective"]: r["state"] for r in payload["results"]}
+        assert states["error_rate"] == "burning"
+        recs = [json.loads(ln)
+                for ln in events.read_text().splitlines()]
+        assert all(r["ev"] == "slo" for r in recs)
+        assert any(r["state"] == "burning" for r in recs)
+
+    def test_missing_data_exits_one(self, tmp_path):
+        res = CliRunner().invoke(telemetry_cli, [
+            "slo-report", "--objectives", str(self._objectives(tmp_path)),
+        ])
+        assert res.exit_code == 1, res.output
+
+    def test_stale_prom_file_warns(self, tmp_path):
+        prom = tmp_path / "router.prom"
+        prom.write_text(
+            "progen_router_ttft_seconds{quantile=\"0.95\"} 0.1\n"
+        )
+        old = time.time() - 3600
+        os.utime(prom, (old, old))
+        res = CliRunner().invoke(telemetry_cli, [
+            "slo-report", "--objectives", str(self._objectives(tmp_path)),
+            "--prom", str(prom),
+        ])
+        assert res.exit_code == 1, res.output
+        assert "stale" in res.output
+
+    def test_watch_mode_ticks_and_exits(self, tmp_path):
+        m = self._metrics_file(tmp_path, [
+            (990.0, 100, 0, 1, 0.1), (1000.0, 200, 100, 1, 0.1),
+        ])
+        res = CliRunner().invoke(telemetry_cli, [
+            "slo-report", "--objectives", str(self._objectives(tmp_path)),
+            "--metrics", str(m), "--watch", "0", "--max-ticks", "2",
+            "--events-out", str(tmp_path / "w.jsonl"),
+        ])
+        # wall-clock "now" vs year-1970-ish sample times: everything in
+        # the window is empty → ratio 0/0 ok... availability no data →
+        # warn; the point here is only that watch terminates and gates
+        assert res.exit_code in (1, 2), res.output
+
+    def test_default_objectives_shipped_config(self, tmp_path):
+        # no --objectives: the repo's configs/serving/slo.toml loads
+        res = CliRunner().invoke(telemetry_cli, ["slo-report"])
+        assert res.exit_code == 1, res.output  # no data → warn
+
+
+class TestExitCodeContract:
+    def test_precedence(self):
+        from progen_tpu.telemetry.slo import SloResult
+
+        ok = SloResult("a", "ratio", STATE_OK, 0.0, 0.0)
+        warn = SloResult("b", "ratio", STATE_WARN, 1.0, 1.5)
+        burn = SloResult("c", "ratio", STATE_BURNING, 9.0, 9.0)
+        assert exit_code([ok]) == 0
+        assert exit_code([ok, warn]) == 1
+        assert exit_code([ok, warn, burn]) == 2
+        assert exit_code([]) == 0

@@ -251,3 +251,124 @@ class TestStitchFiles:
         res = CliRunner().invoke(telemetry_cli, ["stitch", str(p0)])
         assert res.exit_code == 0, res.output
         assert "no clock_beacon records" in res.output
+
+
+def _serving_fleet_streams(trace="t9:1"):
+    """Router + two replicas, everything stamped pid 0 (one machine):
+    the request dispatches to replica A, which dies midstream; the
+    router hands the stream off to replica B."""
+
+    def req(ph, rid, name, ts, **attrs):
+        return {"ev": "req", "ph": ph, "req": rid, "name": name,
+                "ts": ts, "pid": 0, "trace_id": trace, **attrs}
+
+    router = [
+        req("b", "q1-a", "request", 10.00, id="a"),
+        req("b", "q1-a", "queued", 10.00),
+        req("e", "q1-a", "queued", 10.05),
+        req("b", "q1-a", "dispatched", 10.05, replica=0, hop=1),
+        req("e", "q1-a", "dispatched", 11.00),
+        req("b", "q1-a", "dispatched", 11.00, replica=1, hop=2,
+            resumed=True),
+        req("e", "q1-a", "dispatched", 12.00),
+        req("e", "q1-a", "request", 12.00, status="ok"),
+    ]
+    rep_a = [
+        req("b", "7:q1-a", "request", 10.06),
+        req("b", "7:q1-a", "prefill", 10.10),
+        # SIGKILL: phases never close — the honest partial track
+    ]
+    rep_b = [
+        req("b", "8:q1-a", "request", 11.02, resumed=True),
+        req("e", "8:q1-a", "request", 11.90, status="ok"),
+    ]
+    return router, rep_a, rep_b
+
+
+class TestRequestJourneys:
+    """The tentpole acceptance: one contiguous per-request journey
+    across router → dead replica → survivor, drawn as dispatch/handoff
+    flow arrows and tabulated in progenTraces."""
+
+    def test_force_hosts_gives_distinct_tracks(self):
+        router, rep_a, rep_b = _serving_fleet_streams()
+        trace = stitch_streams([router, rep_a, rep_b], force_hosts=True)
+        pids = {
+            e["pid"] for e in trace["traceEvents"]
+            if e.get("cat") == "request"
+        }
+        assert pids == {0, 1, 2}
+
+    def test_single_trace_with_dispatch_and_handoff_arrows(self):
+        router, rep_a, rep_b = _serving_fleet_streams()
+        trace = stitch_streams([router, rep_a, rep_b], force_hosts=True)
+        journeys = trace["progenTraces"]
+        assert list(journeys) == ["t9:1"]
+        j = journeys["t9:1"]
+        assert j["pids"] == [0, 1, 2]   # ONE contiguous journey
+        assert j["hops"] == 2
+        assert j["handoffs"] == 1
+        assert j["flows"] == 2
+        flows = [e for e in trace["traceEvents"]
+                 if e.get("cat") == "request_flow"]
+        by_name = {}
+        for e in flows:
+            by_name.setdefault(e["name"], []).append(e)
+        # dispatch arrow: router (pid 0) → first replica (pid 1)
+        assert [e["ph"] for e in by_name["dispatch"]] == ["s", "f"]
+        assert [e["pid"] for e in by_name["dispatch"]] == [0, 1]
+        # handoff arrow: router → the SURVIVOR (pid 2), not the corpse
+        assert [e["ph"] for e in by_name["handoff"]] == ["s", "f"]
+        assert [e["pid"] for e in by_name["handoff"]] == [0, 2]
+        assert trace["progenStitch"]["request_flows"] == 2
+
+    def test_traces_kept_apart(self):
+        ra, aa, ba = _serving_fleet_streams("t9:1")
+        rb, ab, bb = _serving_fleet_streams("t9:2")
+        # second journey shifted in time so dispatch pairing can't
+        # cross-match between traces even though ids differ
+        for rec in rb + ab + bb:
+            rec["ts"] += 100.0
+        trace = stitch_streams(
+            [ra + rb, aa + ab, ba + bb], force_hosts=True
+        )
+        assert set(trace["progenTraces"]) == {"t9:1", "t9:2"}
+        for j in trace["progenTraces"].values():
+            assert j["flows"] == 2
+
+    def test_no_force_hosts_no_arrows(self):
+        # every process stamps pid 0: replica begins are
+        # indistinguishable from the router's own envelope, so the
+        # stitcher refuses to guess rather than draw wrong arrows
+        router, rep_a, rep_b = _serving_fleet_streams()
+        trace = stitch_streams([router, rep_a, rep_b])
+        assert trace["progenStitch"]["request_flows"] == 0
+
+    def test_records_without_trace_id_ignored(self):
+        router, rep_a, rep_b = _serving_fleet_streams()
+        for rec in router + rep_a + rep_b:
+            rec.pop("trace_id")
+        trace = stitch_streams([router, rep_a, rep_b], force_hosts=True)
+        assert "progenTraces" not in trace
+        assert trace["progenStitch"]["request_flows"] == 0
+
+    def test_cli_stitch_force_hosts_reports_journeys(self, tmp_path):
+        router, rep_a, rep_b = _serving_fleet_streams()
+        paths = []
+        for i, stream in enumerate([router, rep_a, rep_b]):
+            p = tmp_path / f"e{i}.jsonl"
+            with p.open("w") as f:
+                for rec in stream:
+                    f.write(json.dumps(rec) + "\n")
+            paths.append(str(p))
+        out = tmp_path / "fleet.json"
+        res = CliRunner().invoke(
+            telemetry_cli,
+            ["stitch", *paths, "--force-hosts", "--out", str(out)],
+        )
+        assert res.exit_code == 0, res.output
+        assert "1 request journeys" in res.output
+        assert "2 dispatch/handoff arrows" in res.output
+        assert "(1 handoffs)" in res.output
+        on_disk = json.loads(out.read_text())
+        assert on_disk["progenTraces"]["t9:1"]["handoffs"] == 1
