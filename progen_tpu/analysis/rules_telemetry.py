@@ -55,6 +55,20 @@ dashboard query then matches nothing. Three checks:
     exactly ``trace_id`` — the stitcher's journey grouping and the
     kill-matrix contiguity assert grep that one key; a literal
     ``"trace"``/``"traceid"``-style key is a silently-dropped hop.
+  * ``"ev": "sample"`` dict literals (the fleet collector's scrape
+    records) may only be built in ``telemetry/collector.py`` — every
+    sample goes through ``make_sample`` so the TSDB, the fleet
+    aggregator, and the console all agree on one schema; a literal
+    ``"role"`` must be ``replica``/``router``/``run``. Checked on ALL
+    dict literals (not just ``emit(...)`` args): samples are written
+    through the TSDB, not the telemetry sink.
+  * ``"ev": "alert"`` dict literals may only be built in
+    ``telemetry/alerts.py`` (the ``AlertSink`` constructors), must
+    carry the ``kind``/``state``/``source``/``objective`` fields the
+    alert relay and the CI fleet-metrics smoke key on, and literal
+    ``kind``/``state`` values must come from the
+    ``staleness``/``slo_burn`` and
+    ``stale``/``fresh``/``warn``/``burning``/``resolved`` alphabets.
 """
 
 from __future__ import annotations
@@ -117,6 +131,72 @@ class TelemetryHygieneRule(Rule):
                 for k in node.args[0].keys:
                     if _str_const(k):
                         self._check_prom_name(k, k.value)
+
+    # collector-record grammar: checked on every dict literal, because
+    # samples/alerts reach disk through the TSDB / AlertSink file, not
+    # through emit() — an emit-only check would never see them
+    _ALERT_FIELDS = ("kind", "state", "source", "objective")
+    _ALERT_KINDS = ("staleness", "slo_burn")
+    _ALERT_STATES = ("stale", "fresh", "warn", "burning", "resolved")
+    _SAMPLE_ROLES = ("replica", "router", "run")
+
+    def visit_Dict(self, node: ast.Dict) -> None:
+        self.generic_visit(node)
+        for k, v in zip(node.keys, node.values):
+            if not (_str_const(k) and k.value == "ev" and _str_const(v)):
+                continue
+            if v.value == "sample":
+                if not self._in_module("telemetry/collector.py"):
+                    self.report(
+                        v,
+                        "raw collector sample record built outside "
+                        "telemetry/collector.py — the TSDB, the fleet "
+                        "aggregator and the ops console all parse one "
+                        "schema; build samples with make_sample()",
+                    )
+                self._check_literal_member(
+                    node, "role", self._SAMPLE_ROLES,
+                    "sample record 'role'",
+                    "fleet aggregation buckets liveness by exactly "
+                    "these roles",
+                )
+            elif v.value == "alert":
+                if not self._in_module("telemetry/alerts.py"):
+                    self.report(
+                        v,
+                        "raw alert record built outside "
+                        "telemetry/alerts.py — alerts are edge-triggered "
+                        "state machines; a hand-rolled record bypasses "
+                        "the transition dedup and the field grammar the "
+                        "relay/CI smoke key on; go through AlertSink",
+                    )
+                present = {
+                    kk.value for kk in node.keys if _str_const(kk)
+                }
+                missing = [
+                    f for f in self._ALERT_FIELDS if f not in present
+                ]
+                if missing:
+                    self.report(
+                        v,
+                        f"alert record missing field(s) "
+                        f"{'/'.join(missing)} — the alert relay and the "
+                        f"fleet-metrics smoke key on "
+                        f"kind/state/source/objective being present on "
+                        f"every alert",
+                    )
+                self._check_literal_member(
+                    node, "kind", self._ALERT_KINDS,
+                    "alert record 'kind'",
+                    "only staleness and slo_burn alerts exist; a new "
+                    "kind needs the grammar (and this rule) extended",
+                )
+                self._check_literal_member(
+                    node, "state", self._ALERT_STATES,
+                    "alert record 'state'",
+                    "the console colors and the smoke's quiet/burn "
+                    "asserts only know these states",
+                )
 
     def _check_span_name(self, node: ast.Call) -> None:
         name_arg = node.args[0]

@@ -1,0 +1,174 @@
+"""``progen-tpu-collector`` — the fleet metrics scrape loop.
+
+Point it at the exposition files the fleet already writes (replica and
+router ``--prom_file`` textfiles, tracker ``metrics.jsonl`` streams)
+and it ticks forever: scrape → stamp → append to the ring-buffer TSDB,
+with staleness and fleet-SLO burn transitions fanned into an alerts
+JSONL. Deliberately jax-free — it is a sidecar, not a replica — so it
+starts in milliseconds and can run on any host that sees the files.
+
+Sources come from repeatable ``--source name=...,role=...,prom=...``
+specs (the router's ``--replica`` syntax) or a flat TOML
+(``configs/serving/collector.toml`` is the shipped example); flags
+override config values.
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+import time
+
+import click
+
+from progen_tpu.telemetry.alerts import AlertSink
+from progen_tpu.telemetry.collector import (
+    Collector,
+    load_collector_config,
+    parse_source_spec,
+)
+from progen_tpu.telemetry.slo import load_objectives
+from progen_tpu.telemetry.tsdb import RingTSDB
+
+
+@click.command()
+@click.option(
+    "--tsdb", "tsdb_dir", required=True,
+    type=click.Path(file_okay=False),
+    help="ring-buffer store directory (created if missing; one "
+         "collector per directory)",
+)
+@click.option(
+    "--source", "source_specs", multiple=True,
+    help="scrape target: name=r0,role=replica,prom=/path/metrics.prom"
+         "[,metrics=/path/metrics.jsonl] — repeatable",
+)
+@click.option(
+    "--config", "config_path",
+    type=click.Path(exists=True, dir_okay=False), default=None,
+    help="flat TOML with [collector] settings and [source_<name>] "
+         "tables (flags override)",
+)
+@click.option(
+    "--interval", type=float, default=None,
+    help="seconds between scrapes [default: 2]",
+)
+@click.option(
+    "--stale-after", type=float, default=None,
+    help="exposition age (s) past which a source counts as down "
+         "[default: 10]",
+)
+@click.option(
+    "--budget-bytes", type=int, default=None,
+    help="TSDB ring byte budget; over it, old blocks downsample then "
+         "drop [default: 8 MiB]",
+)
+@click.option(
+    "--block-bytes", type=int, default=None,
+    help="TSDB block size before seal-and-rotate [default: 256 KiB]",
+)
+@click.option(
+    "--slo", "slo_path",
+    type=click.Path(exists=True, dir_okay=False), default=None,
+    help="objectives TOML: evaluate fleet SLOs each tick and alert on "
+         "burn transitions",
+)
+@click.option(
+    "--alerts-out", type=click.Path(dir_okay=False), default=None,
+    help="alerts JSONL path [default: <tsdb>/alerts.jsonl]",
+)
+@click.option(
+    "--max-ticks", type=int, default=0, show_default=True,
+    help="stop after N scrapes (0 = run until SIGTERM/SIGINT)",
+)
+@click.option(
+    "--once", is_flag=True, help="single scrape, then exit (CI probes)"
+)
+def main(
+    tsdb_dir, source_specs, config_path, interval, stale_after,
+    budget_bytes, block_bytes, slo_path, alerts_out, max_ticks, once,
+):
+    """Scrape fleet metrics sources into a bounded TSDB + alert sink."""
+    settings = {}
+    sources = []
+    if config_path is not None:
+        settings, sources = load_collector_config(config_path)
+    try:
+        sources += [parse_source_spec(s) for s in source_specs]
+    except ValueError as e:
+        raise click.UsageError(str(e))
+    if not sources:
+        raise click.UsageError(
+            "no sources: pass --source and/or --config"
+        )
+    interval = float(
+        interval if interval is not None
+        else settings.get("interval_s", 2.0)
+    )
+    stale_after = float(
+        stale_after if stale_after is not None
+        else settings.get("stale_after_s", 10.0)
+    )
+    budget_bytes = int(
+        budget_bytes if budget_bytes is not None
+        else settings.get("budget_bytes", 8 << 20)
+    )
+    block_bytes = int(
+        block_bytes if block_bytes is not None
+        else settings.get("block_bytes", 256 << 10)
+    )
+    if slo_path is None:
+        slo_path = settings.get("slo") or None
+    cfg = load_objectives(slo_path) if slo_path else None
+
+    tsdb = RingTSDB(
+        tsdb_dir, budget_bytes=budget_bytes, block_bytes=block_bytes
+    )
+    alerts = AlertSink(
+        alerts_out if alerts_out is not None
+        else tsdb.root / "alerts.jsonl"
+    )
+    coll = Collector(
+        tsdb, sources, stale_after_s=stale_after,
+        slo_cfg=cfg, alerts=alerts,
+    )
+    click.echo(
+        f"collector: {len(sources)} sources -> {tsdb.root} "
+        f"(every {interval:g}s, stale after {stale_after:g}s, "
+        f"budget {budget_bytes} B"
+        + (", fleet SLOs on" if cfg else "") + ")",
+        err=True,
+    )
+
+    stop = {"flag": False}
+
+    def _stop(signum, frame):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+
+    ticks = 0
+    try:
+        while not stop["flag"]:
+            coll.scrape_once()
+            ticks += 1
+            if once or (max_ticks and ticks >= max_ticks):
+                break
+            deadline = time.time() + interval
+            while not stop["flag"] and time.time() < deadline:
+                time.sleep(min(0.2, interval))
+    finally:
+        tsdb.close()
+        alerts.close()
+    click.echo(
+        f"collector: {ticks} ticks, {len(tsdb.blocks())} blocks, "
+        f"{tsdb.total_bytes()} bytes, "
+        f"{tsdb.dropped_lines} torn lines dropped",
+        err=True,
+    )
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -274,6 +274,12 @@ def _build(checkpoint_path, max_slots, max_len, max_queue,
 @click.option("--prom_port", default=0,
               help="serve Prometheus text exposition over HTTP on this "
                    "localhost port (0 = off)")
+@click.option("--heartbeat", default=0.0,
+              help="rewrite --prom_file at least every N seconds even "
+                   "when idle (0 = only on the --metrics-every cadence). "
+                   "The fleet collector reads exposition mtime as the "
+                   "liveness signal; without a heartbeat an idle but "
+                   "healthy replica looks dead")
 @click.option("--journal_dir", default=None, type=str,
               help="journal accepted requests + emitted tokens to "
                    "DIR/journal.jsonl (crash-safe, append-only) so a "
@@ -289,7 +295,8 @@ def _build(checkpoint_path, max_slots, max_len, max_queue,
                    "(0 = off; SIGHUP always triggers a reload)")
 def main(checkpoint_path, max_slots, max_queue, max_len, quantize_int8,
          top_k, temperature, top_p, seed, socket_path, metrics_every,
-         prom_file, prom_port, journal_dir, replay_dir, reload_watch):
+         prom_file, prom_port, heartbeat, journal_dir, replay_dir,
+         reload_watch):
     from progen_tpu import telemetry
     from progen_tpu.resilience.chaos import install_from_env
     from progen_tpu.telemetry import (
@@ -331,6 +338,10 @@ def main(checkpoint_path, max_slots, max_queue, max_len, quantize_int8,
             file=sys.stderr,
         )
 
+    import time as _time
+
+    hb = {"last": _time.monotonic()}
+
     def publish(step=None):
         # compile counts ride the metrics: the router's kill-matrix
         # reads the survivor's prom file to prove handoff didn't trigger
@@ -344,6 +355,7 @@ def main(checkpoint_path, max_slots, max_queue, max_len, quantize_int8,
         sched.metrics.log_to(tracker, step=step)
         if prom_file:
             write_prometheus(prom_file, prometheus_text(sched.metrics))
+            hb["last"] = _time.monotonic()
 
     prom_srv = None
     if prom_port:
@@ -374,6 +386,12 @@ def main(checkpoint_path, max_slots, max_queue, max_len, quantize_int8,
 
     def tick():
         """Once per serve-loop iteration, between decode steps."""
+        # prom rewrite only (no tracker row): mtime freshness for the
+        # fleet collector's staleness check, without metrics.jsonl spam
+        if heartbeat and prom_file \
+                and _time.monotonic() - hb["last"] >= heartbeat:
+            write_prometheus(prom_file, prometheus_text(sched.metrics))
+            hb["last"] = _time.monotonic()
         if reload_req["flag"]:
             reload_req["flag"] = False
             if reloader.request_reload():

@@ -502,6 +502,12 @@ _DEFAULT_OBJECTIVES = (
          "burn.stale_after_s marks the source stale",
 )
 @click.option(
+    "--tsdb", "tsdb_dir", type=click.Path(file_okay=False), default=None,
+    help="collector TSDB directory: evaluate the fleet-AGGREGATED "
+         "series (reset-safe summed counters, merged quantiles) "
+         "instead of per-file evidence",
+)
+@click.option(
     "--events-out", type=click.Path(dir_okay=False), default=None,
     help="append ev:slo state-transition records to this events.jsonl",
 )
@@ -519,8 +525,8 @@ _DEFAULT_OBJECTIVES = (
     help="stop --watch after N evaluations (0 = run until killed)",
 )
 def slo_report_cmd(
-    objectives, metrics_paths, prom_paths, events_out, json_out,
-    watch_s, max_ticks,
+    objectives, metrics_paths, prom_paths, tsdb_dir, events_out,
+    json_out, watch_s, max_ticks,
 ):
     """Judge the fleet's SLOs and exit 0 (ok) / 1 (warn) / 2 (burning).
 
@@ -539,6 +545,21 @@ def slo_report_cmd(
             slo_mod.samples_from_metrics(iter_jsonl(mp, drops))
             for mp in metrics_paths
         ]
+        if tsdb_dir is not None:
+            from progen_tpu.telemetry.collector import fleet_series
+            from progen_tpu.telemetry.tsdb import TsdbReader
+
+            fleet = fleet_series(TsdbReader(tsdb_dir).read(drops))
+            series.append(fleet)
+            if fleet:
+                click.echo(
+                    f"fleet series: {len(fleet)} ticks from {tsdb_dir}",
+                    err=True,
+                )
+            else:
+                click.echo(
+                    f"WARNING: no samples in tsdb {tsdb_dir}", err=True
+                )
         proms = []
         for pp in prom_paths:
             got = slo_mod.read_prom_file(pp)
@@ -584,18 +605,7 @@ def slo_report_cmd(
     click.echo(slo_mod.render_report(cfg, results))
     _echo_drops(drops.count)
     if json_out is not None:
-        payload = {
-            "exit": slo_mod.exit_code(results),
-            "results": [
-                {
-                    "objective": r.objective, "kind": r.kind,
-                    "state": r.state, "burn_short": r.burn_short,
-                    "burn_long": r.burn_long, "value": r.value,
-                    "detail": r.detail,
-                }
-                for r in results
-            ],
-        }
+        payload = slo_mod.results_payload(results)
         Path(json_out).parent.mkdir(parents=True, exist_ok=True)
         Path(json_out).write_text(json.dumps(payload, indent=2))
     if sink is not None:

@@ -1,0 +1,87 @@
+"""``progen-tpu-top`` — live fleet ops console over a collector TSDB.
+
+Opens the store READ-ONLY (never races the collector) and renders the
+``console.build_snapshot`` view: per-source up/age/slots/queue/latency
+rows, the fleet rollup, SLO burn states, recent alerts, and the TSDB's
+own health line.
+
+Keys (watch mode): ``q`` quits; any other key refreshes immediately.
+``--once`` renders a single frame; ``--once --json`` dumps the exact
+snapshot dict as JSON — the scripting/CI surface, asserted by the
+tier-1 fleet-metrics smoke.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import click
+
+from progen_tpu.telemetry import console as console_mod
+from progen_tpu.telemetry.slo import load_objectives
+from progen_tpu.telemetry.tsdb import TsdbReader
+
+
+@click.command()
+@click.option(
+    "--tsdb", "tsdb_dir", required=True,
+    type=click.Path(exists=True, file_okay=False),
+    help="collector store directory to watch",
+)
+@click.option(
+    "--slo", "slo_path",
+    type=click.Path(exists=True, dir_okay=False), default=None,
+    help="objectives TOML: show fleet SLO states in the dashboard",
+)
+@click.option(
+    "--alerts", "alerts_path", type=click.Path(dir_okay=False),
+    default=None,
+    help="alerts JSONL [default: <tsdb>/alerts.jsonl when present]",
+)
+@click.option(
+    "--refresh", type=float, default=2.0, show_default=True,
+    help="seconds between frames in watch mode",
+)
+@click.option(
+    "--frames", type=int, default=0, show_default=True,
+    help="stop watch mode after N frames (0 = until q/killed)",
+)
+@click.option("--once", is_flag=True, help="render one frame and exit")
+@click.option(
+    "--json", "json_out", is_flag=True,
+    help="with --once: print the snapshot as JSON instead of ANSI",
+)
+@click.option(
+    "--color/--no-color", default=None,
+    help="force ANSI color on/off [default: on for TTYs]",
+)
+def main(tsdb_dir, slo_path, alerts_path, refresh, frames, once,
+         json_out, color):
+    """Live ANSI dashboard (or one-shot JSON) for the metrics fleet."""
+    tsdb = TsdbReader(tsdb_dir)
+    cfg = load_objectives(slo_path) if slo_path else None
+    if alerts_path is None:
+        default_alerts = tsdb.root / "alerts.jsonl"
+        alerts_path = default_alerts if default_alerts.exists() else None
+    if color is None:
+        color = sys.stdout.isatty()
+    if json_out and not once:
+        raise click.UsageError("--json requires --once")
+    if once:
+        snap = console_mod.build_snapshot(
+            tsdb, slo_cfg=cfg, alerts_path=alerts_path
+        )
+        if json_out:
+            click.echo(console_mod.snapshot_json(snap))
+        else:
+            click.echo(console_mod.render(snap, color=color))
+        return
+    console_mod.watch(
+        tsdb, slo_cfg=cfg, alerts_path=alerts_path,
+        refresh_s=refresh, color=color,
+        max_frames=frames if frames > 0 else None,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -462,6 +462,15 @@ class Router:
             self.metrics.set_gauge(
                 f"replica{link.index}_up", 1.0 if link.up else 0.0
             )
+            # re-export the load signals scraped for placement, so the
+            # fleet collector sees per-replica pressure through the
+            # router's exposition even when replica files are remote
+            for key in ("queue_depth", "slot_occupancy",
+                        "decode_compile_count"):
+                if key in link.health:
+                    self.metrics.set_gauge(
+                        f"replica{link.index}_{key}", link.health[key]
+                    )
             if self._stale(link, now):
                 stale += 1
         self.metrics.set_gauge("replicas_stale", stale)

@@ -150,6 +150,20 @@ class Scheduler:
         # Prometheus exposition is stable before the first request
         for fam in ("ttft_s", "itl_s", "latency_s"):
             self.metrics.declare_timing(fam)
+        # slot pressure and recompile counts are gauges from the start:
+        # the fleet collector watches both live, not just the trace
+        # counter track / the stderr summary line
+        self.metrics.set_gauge("slot_occupancy", 0)
+        self.metrics.set_gauge("slots_free", self.engine.max_slots)
+        self._publish_compile_gauges()
+
+    def _publish_compile_gauges(self) -> None:
+        self.metrics.set_gauge(
+            "decode_compile_count", self.engine.decode_compile_count()
+        )
+        self.metrics.set_gauge(
+            "prefill_compile_count", self.engine.prefill_compile_count()
+        )
 
     # ----- request tracing ------------------------------------------------
 
@@ -177,6 +191,10 @@ class Scheduler:
         if n == self._last_slots_emitted:
             return
         self._last_slots_emitted = n
+        self.metrics.set_gauge("slot_occupancy", n)
+        self.metrics.set_gauge(
+            "slots_free", self.engine.max_slots - n
+        )
         get_telemetry().emit({
             "ev": "slots", "ts": time.time(), "in_use": n,
             "free": self.engine.max_slots - n,
@@ -476,6 +494,10 @@ class Scheduler:
         self.metrics.inc("decode_tokens", n_live)
         self.metrics.add_time("decode_time_s", t1 - t0)
         self.metrics.set_gauge("active_slots", len(self._active))
+        # recompiles surface the step they happen, not at the next
+        # --metrics-every publish — a recompile storm is exactly when
+        # the console needs to see the count move
+        self._publish_compile_gauges()
         return events, embed_done + completions
 
     def _finish(self, slot: int, rec: _Active, now: float) -> Completion:

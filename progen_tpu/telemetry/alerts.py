@@ -1,0 +1,81 @@
+"""Alert sink: transition records → an alerts JSONL (webhook file).
+
+Alerts are the collector's *actionable* output — everything else it
+writes is evidence. Two kinds, both edge-triggered (a condition that
+holds for an hour produces exactly two lines: onset and recovery):
+
+  * ``kind:"staleness"`` — a source's ``up`` bit flipped: its
+    exposition file stopped refreshing (process dead or wedged) or
+    came back;
+  * ``kind:"slo_burn"`` — the fleet-SLO watchtower crossed a state
+    edge (``warn``/``burning``/``resolved``), forwarded from
+    ``SloWatch`` so the paging decision rides the *merged* fleet
+    series, not any single replica's file.
+
+The sink file uses the journal's write discipline (append, one line,
+flush) so a tail -f or a webhook relay can follow it live; ``ev:
+"alert"`` records are built only here (PGL006 enforces the grammar:
+kind/state alphabets, source/objective always present).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from progen_tpu.telemetry.spans import EventLog
+
+ALERT_KINDS = ("staleness", "slo_burn")
+ALERT_STATES = ("stale", "fresh", "warn", "burning", "resolved")
+
+
+class AlertSink:
+    """Append-only ``ev:"alert"`` writer over an :class:`EventLog`;
+    keeps the most recent records in memory for the console."""
+
+    def __init__(self, path, keep: int = 64):
+        self._log = EventLog(path)
+        self.path = self._log.path
+        self.keep = int(keep)
+        self.recent: List[dict] = []
+
+    def close(self) -> None:
+        self._log.close()
+
+    def _emit(self, rec: dict) -> dict:
+        self._log.emit(rec)
+        self.recent.append(rec)
+        del self.recent[: -self.keep]
+        return rec
+
+    def staleness(
+        self,
+        source: str,
+        up: bool,
+        age_s: float,
+        now: Optional[float] = None,
+    ) -> dict:
+        return self._emit({
+            "ev": "alert",
+            "ts": float(time.time() if now is None else now),
+            "kind": "staleness",
+            "state": "fresh" if up else "stale",
+            "source": str(source),
+            "objective": "",
+            "age_s": round(float(age_s), 3),
+        })
+
+    def slo_transition(self, slo_rec: dict) -> dict:
+        """Forward one ``ev:"slo"`` transition record (SloWatch output)
+        as an alert; the original burn numbers ride along."""
+        return self._emit({
+            "ev": "alert",
+            "ts": float(slo_rec.get("ts", time.time())),
+            "kind": "slo_burn",
+            "state": str(slo_rec.get("state", "warn")),
+            "source": "fleet",
+            "objective": str(slo_rec.get("objective", "")),
+            "burn_short": slo_rec.get("burn_short"),
+            "burn_long": slo_rec.get("burn_long"),
+            "value": slo_rec.get("value"),
+        })

@@ -1,0 +1,262 @@
+"""Ops console rendering: TSDB → snapshot dict → ANSI dashboard.
+
+``build_snapshot`` is the single source of truth for what the console
+knows — ``progen-tpu-top`` renders it as a live ANSI screen for humans
+and dumps it verbatim as JSON for scripts (``--once --json``), so CI
+asserts against exactly what an operator would see:
+
+  * one row per source: up bit, exposition age, slot occupancy, queue
+    depth, ttft/itl p95, completed requests, decode tokens;
+  * fleet rollup from ``fleet_series`` (reset-safe summed counters,
+    merged quantiles, liveness gauges) — the totals line equals the
+    sum of the per-source Prometheus files at scrape time;
+  * SLO states when an objectives TOML is given (same ``evaluate``
+    path as ``slo-report --tsdb``);
+  * the alert tail and the TSDB's own health (blocks, bytes, torn
+    lines dropped) — a console that silently lost history is itself
+    an outage.
+
+Rendering is pure string-building (no curses): the watch loop clears
+the screen between frames, which keeps the console dumb enough to pipe.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, List, Optional
+
+from progen_tpu.telemetry.collector import fleet_series, latest_by_source
+from progen_tpu.telemetry.slo import evaluate, results_payload
+from progen_tpu.telemetry.trace import LineDrops, iter_jsonl
+
+_RESET = "\x1b[0m"
+_BOLD = "\x1b[1m"
+_GREEN = "\x1b[32m"
+_RED = "\x1b[31m"
+_YELLOW = "\x1b[33m"
+_DIM = "\x1b[2m"
+
+CLEAR_SCREEN = "\x1b[2J\x1b[H"
+
+
+def build_snapshot(
+    tsdb,
+    slo_cfg=None,
+    alerts_path=None,
+    max_alerts: int = 8,
+) -> dict:
+    """Everything the console shows, as one JSON-able dict."""
+    drops = LineDrops()
+    samples = [r for r in tsdb.read(drops) if r.get("ev") == "sample"]
+    per_source = latest_by_source(samples)
+    fleet = fleet_series(samples)
+    fleet_now: Dict[str, float] = fleet[-1][1] if fleet else {}
+    as_of = fleet[-1][0] if fleet else None
+    sources = [
+        {
+            "name": rec["source"],
+            "role": rec.get("role", ""),
+            "up": bool(rec.get("up")),
+            "age_s": rec.get("age_s", 0.0),
+            "counters": rec.get("counters", {}),
+            "gauges": rec.get("gauges", {}),
+            "timings": rec.get("timings", {}),
+        }
+        for rec in sorted(
+            per_source.values(), key=lambda r: (r.get("role", ""), r["source"])
+        )
+    ]
+    slo: List[dict] = []
+    gate = None
+    if slo_cfg is not None and fleet:
+        payload = results_payload(evaluate(slo_cfg, [fleet]))
+        gate = payload["exit"]
+        slo = payload["results"]
+    alerts: List[dict] = []
+    if alerts_path is not None:
+        try:
+            alerts = [
+                rec for rec in iter_jsonl(alerts_path, drops)
+                if rec.get("ev") == "alert"
+            ][-max_alerts:]
+        except OSError:
+            pass
+    return {
+        "as_of": as_of,
+        "sources": sources,
+        "fleet": fleet_now,
+        "slo": slo,
+        "slo_exit": gate,
+        "alerts": alerts,
+        "tsdb": {
+            "blocks": len(tsdb.blocks()),
+            "bytes": tsdb.total_bytes(),
+            "dropped_lines": tsdb.dropped_lines + drops.count,
+        },
+    }
+
+
+def _c(s: str, code: str, color: bool) -> str:
+    return f"{code}{s}{_RESET}" if color else s
+
+
+def _num(v, fmt: str = "{:.0f}") -> str:
+    if v is None:
+        return "-"
+    try:
+        return fmt.format(float(v))
+    except (TypeError, ValueError):
+        return "-"
+
+
+def _tq(rec: dict, fam: str, key: str):
+    return rec.get("timings", {}).get(fam, {}).get(key)
+
+
+def render(snap: dict, color: bool = True) -> str:
+    """Snapshot → dashboard text (no trailing clear; the watch loop
+    owns the screen)."""
+    lines: List[str] = []
+    as_of = snap.get("as_of")
+    stamp = (
+        time.strftime("%H:%M:%S", time.localtime(as_of))
+        if as_of else "--:--:--"
+    )
+    fleet = snap.get("fleet", {})
+    n_up = int(fleet.get("fleet_up", 0))
+    n_all = int(fleet.get("fleet_sources", 0))
+    head = f"progen-tpu-top  as of {stamp}  sources {n_up}/{n_all} up"
+    lines.append(_c(head, _BOLD, color))
+    hdr = (
+        f"{'SOURCE':<10} {'ROLE':<8} {'UP':<5} {'AGE':>6} {'SLOTS':>6} "
+        f"{'QUEUE':>6} {'TTFT95':>8} {'ITL95':>8} {'DONE':>8} {'TOKENS':>9}"
+    )
+    lines.append(_c(hdr, _DIM, color))
+    for src in snap.get("sources", []):
+        up = src.get("up")
+        g = src.get("gauges", {})
+        c = src.get("counters", {})
+        row = (
+            f"{src.get('name', '?'):<10} {src.get('role', ''):<8} "
+            f"{_c('up', _GREEN, color) if up else _c('DOWN', _RED, color):<5}"
+            f"{'' if color else ''} "
+            f"{_num(src.get('age_s'), '{:.1f}s'):>6} "
+            f"{_num(g.get('slot_occupancy', g.get('active_slots'))):>6} "
+            f"{_num(g.get('queue_depth')):>6} "
+            f"{_num(_tq(src, 'ttft_s', 'p95_s'), '{:.3f}'):>8} "
+            f"{_num(_tq(src, 'itl_s', 'p95_s'), '{:.3f}'):>8} "
+            f"{_num(c.get('requests_completed')):>8} "
+            f"{_num(c.get('decode_tokens', c.get('tokens_forwarded'))):>9}"
+        )
+        lines.append(row)
+    lines.append(
+        "fleet: "
+        f"replicas {int(fleet.get('replicas_live', 0))}/"
+        f"{int(fleet.get('replicas_total', 0))} live  "
+        f"done {_num(fleet.get('requests_completed'))}  "
+        f"tokens {_num(fleet.get('decode_tokens'))}  "
+        f"ttft p95 {_num(fleet.get('ttft_s_p95_s'), '{:.3f}')}s  "
+        f"queue max {_num(fleet.get('queue_depth'))}"
+    )
+    slo = snap.get("slo", [])
+    if slo:
+        lines.append(_c("SLO", _BOLD, color))
+        for r in slo:
+            state = r.get("state", "?")
+            code = {
+                "ok": _GREEN, "warn": _YELLOW, "burning": _RED
+            }.get(state, _DIM)
+            burn = r.get("burn_long")
+            lines.append(
+                f"  {r.get('objective', '?'):<22} "
+                f"{_c(state, code, color):<8} "
+                f"burn {_num(burn, '{:.2f}')}"
+                + (f"  ({r['detail']})" if r.get("detail") else "")
+            )
+    alerts = snap.get("alerts", [])
+    if alerts:
+        lines.append(_c("recent alerts", _BOLD, color))
+        for a in alerts[-5:]:
+            ts = time.strftime(
+                "%H:%M:%S", time.localtime(a.get("ts", 0))
+            )
+            who = a.get("objective") or a.get("source") or "?"
+            state = a.get("state", "?")
+            code = _GREEN if state in ("fresh", "resolved") else _RED
+            lines.append(
+                f"  {ts} {a.get('kind', '?'):<10} {who:<18} "
+                f"{_c(state, code, color)}"
+            )
+    t = snap.get("tsdb", {})
+    lines.append(_c(
+        f"tsdb: {t.get('blocks', 0)} blocks, {t.get('bytes', 0)} bytes, "
+        f"{t.get('dropped_lines', 0)} torn lines dropped",
+        _DIM, color,
+    ))
+    return "\n".join(lines)
+
+
+def snapshot_json(snap: dict) -> str:
+    return json.dumps(snap, indent=2, sort_keys=True, default=str)
+
+
+def watch(
+    tsdb,
+    slo_cfg=None,
+    alerts_path=None,
+    refresh_s: float = 2.0,
+    color: bool = True,
+    max_frames: Optional[int] = None,
+    out=None,
+):
+    """Live loop: clear screen, render, wait. ``q`` quits when stdin is
+    a TTY; otherwise runs until ``max_frames`` (None = forever) — the
+    headless path CI and tests use."""
+    import sys
+
+    out = sys.stdout if out is None else out
+    frames = 0
+    while max_frames is None or frames < max_frames:
+        snap = build_snapshot(
+            tsdb, slo_cfg=slo_cfg, alerts_path=alerts_path
+        )
+        out.write(CLEAR_SCREEN + render(snap, color=color) + "\n")
+        out.flush()
+        frames += 1
+        if max_frames is not None and frames >= max_frames:
+            break
+        if _wait_or_quit(refresh_s):
+            break
+
+
+def _wait_or_quit(timeout_s: float) -> bool:
+    """Sleep ``timeout_s``; True means the operator pressed ``q``."""
+    import select
+    import sys
+
+    stdin = sys.stdin
+    if not hasattr(stdin, "fileno"):
+        time.sleep(timeout_s)
+        return False
+    try:
+        is_tty = stdin.isatty()
+    except (ValueError, OSError):
+        is_tty = False
+    if not is_tty:
+        time.sleep(timeout_s)
+        return False
+    import termios
+    import tty
+
+    fd = stdin.fileno()
+    old = termios.tcgetattr(fd)
+    try:
+        tty.setcbreak(fd)
+        r, _, _ = select.select([stdin], [], [], timeout_s)
+        if r:
+            ch = stdin.read(1)
+            return ch in ("q", "Q")
+        return False
+    finally:
+        termios.tcsetattr(fd, termios.TCSADRAIN, old)

@@ -15,6 +15,13 @@ this module stays import-free of the serving package: counters become
 ``counter`` samples, gauges ``gauge``, and timings ``summary`` families
 with p50/p95/p99 quantile labels from the reservoir — which is how TTFT
 tails finally become visible on a dashboard instead of only a mean.
+
+Contract for fleet aggregation (the collector depends on this): every
+summary family exposes ``_sum`` and ``_count`` alongside its quantiles.
+Quantiles alone cannot be merged across replicas — fleet averages and
+count-weighted quantile merges both need the (sum, count) pair — so a
+renderer change that drops either breaks ``fleet_series``; the
+merge-correctness tests in tests/test_telemetry.py pin it.
 """
 
 from __future__ import annotations

@@ -74,9 +74,47 @@ class _Timing:
             "max_s": self.max,
             "min_s": self.min if self.count else 0.0,
             "count": float(self.count),
+            # cumulative sum rides along because fleet-level averages
+            # are only mergeable from (sum, count) pairs — a mean (or
+            # quantiles) per source cannot be combined after the fact
+            "sum": self.sum,
         }
         for q, key in _QUANTILES:
             out[key] = self.quantile(q)
+        return out
+
+    @classmethod
+    def merged(cls, parts) -> "_Timing":
+        """Combine reservoirs from several sources into one _Timing.
+        sum/count/min/max merge exactly; the merged reservoir is a
+        count-weighted subsample (Efraimidis–Spirakis keys, seeded) of
+        the parts' reservoirs, so each part's influence on the merged
+        quantiles matches its share of observations, not its share of
+        reservoir slots."""
+        out = cls()
+        parts = [p for p in parts if p.count > 0]
+        if not parts:
+            return out
+        out.count = sum(p.count for p in parts)
+        out.sum = sum(p.sum for p in parts)
+        out.min = min(p.min for p in parts)
+        out.max = max(p.max for p in parts)
+        pool = []
+        for p in parts:
+            if not p._reservoir:
+                continue
+            w = p.count / len(p._reservoir)
+            pool.extend((v, w) for v in p._reservoir)
+        if len(pool) <= _RESERVOIR_CAP:
+            out._reservoir = [v for v, _ in pool]
+        else:
+            rng = random.Random(0)
+            keyed = sorted(
+                pool,
+                key=lambda vw: rng.random() ** (1.0 / vw[1]),
+                reverse=True,
+            )
+            out._reservoir = [v for v, _ in keyed[:_RESERVOIR_CAP]]
         return out
 
 

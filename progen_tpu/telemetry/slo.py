@@ -443,6 +443,24 @@ def _round(x: Optional[float]) -> Optional[float]:
     return round(float(x), 4)
 
 
+def results_payload(results: Sequence[SloResult]) -> dict:
+    """JSON-able report form shared by ``slo-report --json``, the ops
+    console snapshot, and the collector's CI artifacts — one spelling
+    of the result schema so scripts never chase two."""
+    return {
+        "exit": exit_code(results),
+        "results": [
+            {
+                "objective": r.objective, "kind": r.kind,
+                "state": r.state, "burn_short": r.burn_short,
+                "burn_long": r.burn_long, "value": r.value,
+                "detail": r.detail,
+            }
+            for r in results
+        ],
+    }
+
+
 class SloWatch:
     """Objective-state machine emitting ``ev: "slo"`` records.
 

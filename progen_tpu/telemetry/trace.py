@@ -58,7 +58,7 @@ INSTANT_EVENTS = (
     "retry", "anomaly", "anomaly_rollback", "stall", "stall_escalation",
     "ckpt_quarantine", "ckpt_commit_failed", "chaos", "goodput",
     "clock_beacon", "request_rejected", "reload", "journal_replay",
-    "route", "slo",
+    "route", "slo", "alert",
 )
 
 # metrics.jsonl columns that get their own counter track

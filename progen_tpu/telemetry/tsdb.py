@@ -1,0 +1,254 @@
+"""Bounded ring-buffer time-series store for fleet samples.
+
+The collector scrapes every few seconds forever; an unbounded JSONL
+would eat the disk in a day. This store keeps hours of fleet history
+in a fixed byte budget by trading *resolution* for *retention*, never
+the reverse:
+
+  * records append to fixed-size JSONL **block** files
+    (``block-<seq>-l<level>.jsonl``); when the active block passes
+    ``block_bytes`` it is sealed and a new one opened — the journal's
+    write discipline (one ``write``+``flush`` per line) so a SIGKILL
+    tears at most the final line;
+  * when total bytes pass ``budget_bytes``, the sealed block with the
+    LOWEST compaction level (ties → oldest) is **downsampled 2:1**:
+    consecutive samples from the same source merge pairwise, keeping
+    the later sample (cumulative counters and timing reservoirs lose
+    nothing), summing the merged-sample tally ``n``, and keeping the
+    *worst* ``up`` of the pair so availability degradation is never
+    compacted away. The rewrite is tmp + ``os.replace`` (atomic) and
+    bumps the filename's level;
+  * a block that reaches ``max_level`` and is still over budget is
+    deleted oldest-first — the ring wraps;
+  * torn tails never poison reads: reopening an active block truncates
+    a partial final line (counted), and block reads go through
+    ``iter_jsonl`` so garbage lines are skipped and tallied into
+    ``dropped_lines`` for the console to surface.
+
+Single-writer by design (one collector process owns a store directory);
+readers (``progen-tpu-top``, ``slo-report --tsdb``) only ever see whole
+lines thanks to the flush-per-line contract.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from pathlib import Path
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from progen_tpu.telemetry.trace import LineDrops, iter_jsonl
+
+_BLOCK_RE = re.compile(r"^block-(\d{8})-l(\d+)\.jsonl$")
+
+
+def _block_name(seq: int, level: int) -> str:
+    return f"block-{seq:08d}-l{level}.jsonl"
+
+
+def merge_pair(a: dict, b: dict) -> dict:
+    """Downsample two consecutive same-source records into one. ``b``
+    (the later sample) wins wholesale — counters/timings are cumulative
+    so dropping ``a`` loses no totals — except the fields where "keep
+    the later" would hide a degradation: ``up`` keeps the pair's worst
+    and ``n`` keeps the tally of raw samples this record stands for."""
+    out = dict(b)
+    na = int(a.get("n", 1))
+    nb = int(b.get("n", 1))
+    out["n"] = na + nb
+    if "up" in a or "up" in b:
+        out["up"] = min(int(a.get("up", 1)), int(b.get("up", 1)))
+    return out
+
+
+class TsdbReader:
+    """Read-only view of a store directory — what ``progen-tpu-top``
+    and ``slo-report --tsdb`` open, so inspecting a live collector's
+    store never races its writer (no truncation, no file handles kept).
+    A torn final line shows up in ``drops``, exactly as a crashed
+    writer's journal would."""
+
+    def __init__(self, root):
+        self.root = Path(root)
+        self.dropped_lines = 0
+
+    def _scan(self) -> List[Tuple[int, int, Path]]:
+        out = []
+        try:
+            entries = list(self.root.iterdir())
+        except OSError:
+            return []
+        for p in entries:
+            m = _BLOCK_RE.match(p.name)
+            if m:
+                out.append((int(m.group(1)), int(m.group(2)), p))
+        out.sort(key=lambda b: b[0])
+        return out
+
+    def total_bytes(self) -> int:
+        return sum(p.stat().st_size for _, _, p in self._scan())
+
+    def blocks(self) -> List[Dict[str, int]]:
+        return [
+            {"seq": seq, "level": level, "bytes": p.stat().st_size}
+            for seq, level, p in self._scan()
+        ]
+
+    def read(self, drops: Optional[LineDrops] = None) -> Iterator[dict]:
+        own = LineDrops()
+        for _, _, path in self._scan():
+            for rec in iter_jsonl(path, own):
+                yield rec
+        if drops is not None:
+            drops.count += own.count
+
+
+class RingTSDB:
+    """Append-only facade over the block directory; see module doc."""
+
+    def __init__(
+        self,
+        root,
+        budget_bytes: int = 8 << 20,
+        block_bytes: int = 256 << 10,
+        max_level: int = 4,
+    ):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self.budget_bytes = int(budget_bytes)
+        self.block_bytes = int(block_bytes)
+        self.max_level = int(max_level)
+        self.dropped_lines = 0
+        self._fh = None
+        self._active_seq = 0
+        self._active_bytes = 0
+        self._open_active()
+
+    # -- block bookkeeping ------------------------------------------------
+
+    def _scan(self) -> List[Tuple[int, int, Path]]:
+        """Sorted (seq, level, path) for every block file on disk."""
+        out = []
+        for p in self.root.iterdir():
+            m = _BLOCK_RE.match(p.name)
+            if m:
+                out.append((int(m.group(1)), int(m.group(2)), p))
+        out.sort(key=lambda b: b[0])
+        return out
+
+    def _open_active(self) -> None:
+        blocks = self._scan()
+        if blocks:
+            seq, level, path = blocks[-1]
+            size = path.stat().st_size
+            if level == 0 and size < self.block_bytes:
+                self._truncate_torn_tail(path)
+                self._active_seq = seq
+                self._fh = path.open("a")
+                self._active_bytes = path.stat().st_size
+                return
+            self._active_seq = seq + 1
+        else:
+            self._active_seq = 1
+        path = self.root / _block_name(self._active_seq, 0)
+        self._fh = path.open("a")
+        self._active_bytes = path.stat().st_size
+
+    def _truncate_torn_tail(self, path: Path) -> None:
+        """Drop a partial final line left by a killed writer so the
+        reopened block appends on a clean line boundary."""
+        try:
+            data = path.read_bytes()
+        except OSError:
+            return
+        if not data or data.endswith(b"\n"):
+            return
+        keep = data.rfind(b"\n") + 1
+        with path.open("r+b") as f:
+            f.truncate(keep)
+        self.dropped_lines += 1
+
+    def _seal_active(self) -> None:
+        self._fh.close()
+        self._active_seq += 1
+        path = self.root / _block_name(self._active_seq, 0)
+        self._fh = path.open("a")
+        self._active_bytes = 0
+
+    # -- public API -------------------------------------------------------
+
+    def append(self, rec: dict) -> None:
+        line = json.dumps(rec, separators=(",", ":")) + "\n"
+        self._fh.write(line)
+        self._fh.flush()
+        self._active_bytes += len(line.encode("utf-8"))
+        if self._active_bytes >= self.block_bytes:
+            self._seal_active()
+            self._enforce_budget()
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def total_bytes(self) -> int:
+        return sum(p.stat().st_size for _, _, p in self._scan())
+
+    def blocks(self) -> List[Dict[str, int]]:
+        return [
+            {"seq": seq, "level": level, "bytes": p.stat().st_size}
+            for seq, level, p in self._scan()
+        ]
+
+    def read(self, drops: Optional[LineDrops] = None) -> Iterator[dict]:
+        """Every record, oldest block first. Skipped lines are counted
+        into ``drops`` (and mirrored on ``dropped_lines``)."""
+        own = LineDrops()
+        for _, _, path in self._scan():
+            for rec in iter_jsonl(path, own):
+                yield rec
+        if drops is not None:
+            drops.count += own.count
+
+    # -- compaction -------------------------------------------------------
+
+    def _enforce_budget(self) -> None:
+        """Downsample (then, at max level, drop) sealed blocks until the
+        directory fits the budget again. Every pass either shrinks a
+        block, bumps its level, or deletes it — so this terminates."""
+        while self.total_bytes() > self.budget_bytes:
+            sealed = [
+                b for b in self._scan() if b[0] != self._active_seq
+            ]
+            if not sealed:
+                return
+            seq, level, path = min(sealed, key=lambda b: (b[1], b[0]))
+            if level >= self.max_level:
+                path.unlink()
+                continue
+            self._downsample(seq, level, path)
+
+    def _downsample(self, seq: int, level: int, path: Path) -> None:
+        drops = LineDrops()
+        recs = list(iter_jsonl(path, drops))
+        self.dropped_lines += drops.count
+        merged: List[dict] = []
+        pending: Dict[object, int] = {}
+        for rec in recs:
+            key = (rec.get("ev"), rec.get("source"))
+            slot = pending.pop(key, None)
+            if slot is None:
+                pending[key] = len(merged)
+                merged.append(rec)
+            else:
+                merged[slot] = merge_pair(merged[slot], rec)
+        tmp = path.with_suffix(".tmp")
+        with tmp.open("w") as f:
+            for rec in merged:
+                f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        dst = self.root / _block_name(seq, level + 1)
+        os.replace(tmp, dst)
+        path.unlink()

@@ -62,3 +62,25 @@ def clean_slo_metrics(reg):
     # transition records are restricted to telemetry/slo.py
     reg.set_gauge("slo_burn_rate", 0.4)
     reg.inc("slo_transitions")
+
+
+def clean_collector_usage(make_sample, sink):
+    # samples/alerts built through their constructors are fine
+    # anywhere — only raw dict literals are restricted
+    rec = make_sample(
+        ts=1.0, source="r0", role="replica", up=True, age_s=0.5
+    )
+    sink.staleness(source="r0", up=False, age_s=12.0)
+    return rec
+
+
+def clean_fleet_metrics(reg):
+    # fleet-rollup METRICS are fine anywhere
+    reg.set_gauge("fleet_up", 3.0)
+    reg.set_gauge("replicas_live", 2.0)
+    reg.inc("alerts_emitted")
+
+
+def clean_other_ev_dict():
+    # dict literals with other ev tags are not the collector's grammar
+    return {"ev": "tsdb_block", "seq": 4, "level": 1}

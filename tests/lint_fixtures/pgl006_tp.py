@@ -1,4 +1,4 @@
-"""PGL006 true positives: telemetry hygiene. Expected findings: 20."""
+"""PGL006 true positives: telemetry hygiene. Expected findings: 28."""
 
 
 def unbounded_span(telemetry, name):
@@ -69,3 +69,33 @@ def bad_slo_state(emit):
     # TP x2: slo record outside telemetry/slo.py AND a state outside
     # the ok/warn/burning/resolved transition alphabet
     emit({"ev": "slo", "objective": "ttft_p95", "state": "melting"})
+
+
+def raw_sample_record():
+    # TP: collector sample record built outside telemetry/collector.py
+    # (checked on the bare dict literal — samples reach disk through
+    # the TSDB, not emit())
+    return {"ev": "sample", "ts": 1.0, "source": "r0",
+            "role": "replica", "up": 1}
+
+
+def bad_sample_role():
+    # TP x2: outside telemetry/collector.py AND a role outside the
+    # replica/router/run fleet-aggregation alphabet
+    return {"ev": "sample", "ts": 1.0, "source": "s0",
+            "role": "sidecar", "up": 1}
+
+
+def raw_alert_record(log):
+    # TP: alert record built outside telemetry/alerts.py (bypasses the
+    # AlertSink transition dedup)
+    log.emit({"ev": "alert", "ts": 1.0, "kind": "staleness",
+              "state": "stale", "source": "r0", "objective": ""})
+
+
+def bad_alert_everything():
+    # TP x4: outside telemetry/alerts.py, missing source/objective
+    # fields, a kind outside staleness/slo_burn, and a state outside
+    # the stale/fresh/warn/burning/resolved alphabet
+    return {"ev": "alert", "ts": 1.0, "kind": "paging",
+            "state": "screaming"}

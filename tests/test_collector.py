@@ -1,0 +1,715 @@
+"""Fleet metrics collector (telemetry/collector.py): source specs,
+prom/jsonl scraping, staleness transitions, reset-safe fleet
+aggregation (the SIGKILL+respawn case), quantile merging, the console
+snapshot, and the collector/top/slo-report CLI surfaces."""
+
+import json
+import os
+
+import pytest
+from click.testing import CliRunner
+
+from progen_tpu.serving.metrics import ServingMetrics
+from progen_tpu.telemetry.alerts import AlertSink
+from progen_tpu.telemetry.collector import (
+    Collector,
+    SourceSpec,
+    _Tail,
+    fleet_series,
+    latest_by_source,
+    load_collector_config,
+    make_sample,
+    merge_quantiles,
+    parse_source_spec,
+    prom_families,
+    split_prom_values,
+)
+from progen_tpu.telemetry.prometheus import prometheus_text
+from progen_tpu.telemetry.slo import load_objectives, parse_prom_text
+from progen_tpu.telemetry.tsdb import RingTSDB, TsdbReader
+
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+FLEET_SLO_TOML = """
+[windows]
+short_s = 60
+long_s = 600
+
+[burn]
+warn = 1.0
+hot = 2.0
+
+[objective_fleet_availability]
+kind = "availability"
+gauge = "replicas_live"
+min_value = 2.0
+target = 0.9
+"""
+
+
+def _sample(ts, source, up=True, role="replica", counters=None,
+            gauges=None, timings=None, age_s=0.0):
+    return make_sample(
+        ts=ts, source=source, role=role, up=up, age_s=age_s,
+        counters=counters, gauges=gauges, timings=timings,
+    )
+
+
+def _serving_metrics(completed=10, submitted=12, queue=3, ttft=None):
+    m = ServingMetrics()
+    m.inc("requests_completed", completed)
+    m.inc("requests_submitted", submitted)
+    m.set_gauge("queue_depth", queue)
+    for v in (ttft or [0.1, 0.2, 0.3]):
+        m.observe("ttft_s", v)
+    return m
+
+
+def _write_prom(path, metrics, mtime, prefix="progen_serve_"):
+    path.write_text(prometheus_text(metrics, prefix=prefix))
+    os.utime(path, (mtime, mtime))
+    return path
+
+
+class TestSourceSpec:
+    def test_parse_full_spec(self):
+        s = parse_source_spec(
+            "name=r0, role=router, prom=/p.prom, metrics=/m.jsonl"
+        )
+        assert (s.name, s.role, s.prom, s.metrics) == (
+            "r0", "router", "/p.prom", "/m.jsonl"
+        )
+
+    def test_role_defaults_to_replica(self):
+        assert parse_source_spec("name=r1,prom=/p").role == "replica"
+
+    @pytest.mark.parametrize("bad", [
+        "prom=/p",                       # missing name
+        "name=r0",                       # neither prom nor metrics
+        "name=r0,port=9090",             # unknown key
+        "name=r0,prom",                  # fragment without '='
+        "name=r0,role=sidecar,prom=/p",  # role outside the alphabet
+    ])
+    def test_bad_specs_rejected(self, bad):
+        with pytest.raises(ValueError):
+            parse_source_spec(bad)
+
+    def test_duplicate_source_names_rejected(self, tmp_path):
+        db = RingTSDB(tmp_path / "tsdb")
+        specs = [SourceSpec(name="r0", prom="/a"),
+                 SourceSpec(name="r0", prom="/b")]
+        with pytest.raises(ValueError, match="duplicate"):
+            Collector(db, specs)
+        db.close()
+
+
+class TestPromSplit:
+    def test_type_scan_recovers_metric_kinds(self):
+        text = prometheus_text(_serving_metrics())
+        fams = prom_families(text)
+        assert fams["requests_completed"] == "counter"
+        assert fams["queue_depth"] == "gauge"
+        assert fams["ttft_s"] == "summary"
+
+    def test_split_against_real_exposition(self):
+        text = prometheus_text(_serving_metrics(completed=7, queue=4))
+        counters, gauges, timings = split_prom_values(
+            parse_prom_text(text), prom_families(text)
+        )
+        assert counters["requests_completed"] == 7.0
+        assert gauges["queue_depth"] == 4.0
+        t = timings["ttft_s"]
+        assert t["count"] == 3.0 and t["sum"] == pytest.approx(0.6)
+        assert set(t) >= {"p50_s", "p95_s", "p99_s", "sum", "count"}
+        # summary samples must not leak into the gauge/counter maps
+        assert "ttft_s_p95_s" not in gauges
+        assert "ttft_s_count" not in counters
+
+    def test_router_prefix_normalizes_to_same_keys(self):
+        m = ServingMetrics()
+        m.inc("dispatched_total", 5)
+        text = prometheus_text(m, prefix="progen_router_")
+        counters, _, _ = split_prom_values(
+            parse_prom_text(text), prom_families(text)
+        )
+        assert counters["dispatched_total"] == 5.0
+
+    def test_untyped_samples_fall_back_to_gauge(self):
+        counters, gauges, _ = split_prom_values({"mystery": 1.0}, {})
+        assert gauges == {"mystery": 1.0} and counters == {}
+
+
+class TestTail:
+    def test_incremental_reads_and_torn_line(self, tmp_path):
+        p = tmp_path / "metrics.jsonl"
+        tail = _Tail(p)
+        assert tail.read_new() == []  # missing file is not an error
+        with p.open("w") as f:
+            f.write(json.dumps({"_time": 1.0, "a": 1}) + "\n")
+            f.flush()
+            assert [r["a"] for r in tail.read_new()] == [1]
+            assert tail.read_new() == []
+            f.write('{"_time": 2.0, "a"')  # torn: writer mid-line
+            f.flush()
+            assert tail.read_new() == []  # left unread, not dropped
+            f.write(": 2}\n")
+            f.flush()
+            assert [r["a"] for r in tail.read_new()] == [2]
+        assert tail.dropped == 0
+
+    def test_garbage_line_counted_dropped(self, tmp_path):
+        p = tmp_path / "metrics.jsonl"
+        p.write_text("not json\n" + json.dumps({"_time": 3.0}) + "\n")
+        tail = _Tail(p)
+        assert len(tail.read_new()) == 1
+        assert tail.dropped == 1
+
+    def test_truncated_file_rewinds(self, tmp_path):
+        p = tmp_path / "metrics.jsonl"
+        p.write_text(
+            json.dumps({"_time": 1.0, "a": 1}) + "\n"
+            + json.dumps({"_time": 2.0, "a": 2}) + "\n"
+        )
+        tail = _Tail(p)
+        assert len(tail.read_new()) == 2
+        # file rewritten shorter (rotation): offset rewinds to zero
+        p.write_text(json.dumps({"_time": 9.0, "a": 9}) + "\n")
+        assert [r["a"] for r in tail.read_new()] == [9]
+
+
+class TestScrape:
+    def test_prom_scrape_stamps_source_role_and_up(self, tmp_path):
+        prom = _write_prom(
+            tmp_path / "r0.prom", _serving_metrics(), mtime=1000.0
+        )
+        db = RingTSDB(tmp_path / "tsdb")
+        coll = Collector(
+            db, [SourceSpec(name="r0", role="replica", prom=str(prom))],
+            stale_after_s=10.0,
+        )
+        (rec,) = coll.scrape_once(now=1002.0)
+        assert rec["ev"] == "sample" and rec["source"] == "r0"
+        assert rec["role"] == "replica" and rec["up"] == 1
+        assert rec["age_s"] == pytest.approx(2.0)
+        assert rec["counters"]["requests_completed"] == 10.0
+        assert rec["gauges"]["queue_depth"] == 3.0
+        assert rec["timings"]["ttft_s"]["count"] == 3.0
+        # the sample landed in the TSDB verbatim
+        assert [r["source"] for r in db.read()] == ["r0"]
+        db.close()
+
+    def test_stale_exposition_reads_down(self, tmp_path):
+        prom = _write_prom(
+            tmp_path / "r0.prom", _serving_metrics(), mtime=1000.0
+        )
+        db = RingTSDB(tmp_path / "tsdb")
+        coll = Collector(
+            db, [SourceSpec(name="r0", prom=str(prom))],
+            stale_after_s=10.0,
+        )
+        (rec,) = coll.scrape_once(now=1030.0)
+        assert rec["up"] == 0 and rec["age_s"] == pytest.approx(30.0)
+        db.close()
+
+    def test_missing_file_is_down_not_fatal(self, tmp_path):
+        db = RingTSDB(tmp_path / "tsdb")
+        coll = Collector(
+            db, [SourceSpec(name="r0", prom=str(tmp_path / "gone.prom"))]
+        )
+        (rec,) = coll.scrape_once(now=1.0)
+        assert rec["up"] == 0
+        db.close()
+
+    def test_metrics_jsonl_source(self, tmp_path):
+        mp = tmp_path / "metrics.jsonl"
+        row = {
+            "_time": 100.0, "_step": 3,
+            "serve/requests_completed": 5.0,
+            "serve/queue_depth": 2.0,
+            "serve/ttft_s_count": 4.0, "serve/ttft_s_sum": 0.8,
+            "serve/ttft_s_p50_s": 0.2, "serve/ttft_s_p95_s": 0.3,
+            "serve/ttft_s_p99_s": 0.3, "serve/ttft_s_mean_s": 0.2,
+        }
+        mp.write_text(json.dumps(row) + "\n")
+        db = RingTSDB(tmp_path / "tsdb")
+        coll = Collector(
+            db, [SourceSpec(name="run", role="run", metrics=str(mp))],
+            stale_after_s=10.0,
+        )
+        (rec,) = coll.scrape_once(now=105.0)
+        assert rec["up"] == 1 and rec["age_s"] == pytest.approx(5.0)
+        assert rec["counters"]["requests_completed"] == 5.0
+        assert rec["gauges"]["queue_depth"] == 2.0
+        t = rec["timings"]["ttft_s"]
+        assert t["count"] == 4.0 and t["sum"] == pytest.approx(0.8)
+        # flat timing-stat keys must not double-land as gauges
+        assert "ttft_s_p95_s" not in rec["gauges"]
+        db.close()
+
+    def test_pre_sum_rows_reconstruct_sum_from_mean(self, tmp_path):
+        mp = tmp_path / "metrics.jsonl"
+        row = {
+            "_time": 50.0, "serve/ttft_s_count": 10.0,
+            "serve/ttft_s_mean_s": 0.25, "serve/ttft_s_p50_s": 0.2,
+        }
+        mp.write_text(json.dumps(row) + "\n")
+        db = RingTSDB(tmp_path / "tsdb")
+        coll = Collector(
+            db, [SourceSpec(name="run", role="run", metrics=str(mp))]
+        )
+        (rec,) = coll.scrape_once(now=51.0)
+        assert rec["timings"]["ttft_s"]["sum"] == pytest.approx(2.5)
+        db.close()
+
+
+class TestStalenessAlerts:
+    def test_edge_triggered_stale_then_fresh(self, tmp_path):
+        prom = _write_prom(
+            tmp_path / "r0.prom", _serving_metrics(), mtime=1000.0
+        )
+        db = RingTSDB(tmp_path / "tsdb")
+        sink = AlertSink(tmp_path / "alerts.jsonl")
+        coll = Collector(
+            db, [SourceSpec(name="r0", prom=str(prom))],
+            stale_after_s=10.0, alerts=sink,
+        )
+        coll.scrape_once(now=1001.0)  # first observation: no alert
+        coll.scrape_once(now=1030.0)  # up -> down edge
+        coll.scrape_once(now=1031.0)  # still down: no repeat
+        os.utime(prom, (1040.0, 1040.0))
+        coll.scrape_once(now=1041.0)  # down -> up edge
+        states = [(a["kind"], a["state"]) for a in sink.recent]
+        assert states == [("staleness", "stale"), ("staleness", "fresh")]
+        assert all(a["source"] == "r0" for a in sink.recent)
+        on_disk = [
+            json.loads(line)
+            for line in (tmp_path / "alerts.jsonl").read_text().splitlines()
+        ]
+        assert [a["state"] for a in on_disk] == ["stale", "fresh"]
+        sink.close()
+        db.close()
+
+    def test_slo_burn_alert_fires_on_fleet_availability(self, tmp_path):
+        slo_toml = tmp_path / "slo.toml"
+        slo_toml.write_text(FLEET_SLO_TOML)
+        cfg = load_objectives(slo_toml)
+        m = _serving_metrics()
+        proms = [
+            _write_prom(tmp_path / f"r{i}.prom", m, mtime=0.0)
+            for i in range(2)
+        ]
+        db = RingTSDB(tmp_path / "tsdb")
+        sink = AlertSink(tmp_path / "alerts.jsonl")
+        coll = Collector(
+            db,
+            [SourceSpec(name=f"r{i}", prom=str(p))
+             for i, p in enumerate(proms)],
+            stale_after_s=45.0, slo_cfg=cfg, alerts=sink,
+        )
+        # healthy half of the long window: both proms kept fresh
+        for t in range(0, 300, 30):
+            for p in proms:
+                os.utime(p, (t, t))
+            coll.scrape_once(now=float(t))
+        # both replicas die: expositions freeze, the fleet series'
+        # replicas_live drops under min_value for short AND long windows
+        for t in range(300, 630, 30):
+            coll.scrape_once(now=float(t))
+        burns = [a for a in sink.recent if a["kind"] == "slo_burn"]
+        assert burns, [a["kind"] for a in sink.recent]
+        assert burns[0]["state"] in ("warn", "burning")
+        assert any(a["state"] == "burning" for a in burns)
+        assert all(a["source"] == "fleet" for a in burns)
+        assert burns[0]["objective"] == "fleet_availability"
+        # the staleness edges fired too (one per replica)
+        stale = [a for a in sink.recent if a["kind"] == "staleness"]
+        assert {a["source"] for a in stale} == {"r0", "r1"}
+        sink.close()
+        db.close()
+
+
+class TestFleetAggregation:
+    def test_counters_sum_across_sources(self):
+        samples = [
+            _sample(1.0, "r0", counters={"requests_completed": 10}),
+            _sample(1.0, "r1", counters={"requests_completed": 7}),
+        ]
+        (t, vals), = fleet_series(samples)
+        assert t == 1.0 and vals["requests_completed"] == 17.0
+        assert vals["replicas_live"] == 2.0
+
+    def test_counter_reset_after_respawn_never_dips_or_spikes(self):
+        # r0 is SIGKILLed between t=2 and t=3 and respawns counting
+        # from zero; r1 lives throughout
+        samples = [
+            _sample(1.0, "r0", counters={"decode_tokens": 100}),
+            _sample(1.0, "r1", counters={"decode_tokens": 50}),
+            _sample(2.0, "r0", counters={"decode_tokens": 110}),
+            _sample(2.0, "r1", counters={"decode_tokens": 60}),
+            # respawned: raw counter reset to near zero
+            _sample(3.0, "r0", counters={"decode_tokens": 5}),
+            _sample(3.0, "r1", counters={"decode_tokens": 70}),
+            _sample(4.0, "r0", counters={"decode_tokens": 12}),
+            _sample(4.0, "r1", counters={"decode_tokens": 80}),
+        ]
+        series = fleet_series(samples)
+        totals = [vals["decode_tokens"] for _, vals in series]
+        assert totals == [150.0, 170.0, 185.0, 202.0]
+        deltas = [b - a for a, b in zip(totals, totals[1:])]
+        assert all(d >= 0 for d in deltas), totals  # never negative
+        assert max(deltas) <= 25, totals            # never spiked
+        # final total = work across both of r0's lives + r1
+        assert totals[-1] == (110 + 12) + 80
+
+    def test_dead_source_keeps_contributing_last_total(self):
+        samples = [
+            _sample(1.0, "r0", counters={"requests_completed": 10}),
+            _sample(1.0, "r1", counters={"requests_completed": 5}),
+            # r1 stops reporting entirely; its finished work remains
+            _sample(2.0, "r0", counters={"requests_completed": 12}),
+        ]
+        series = fleet_series(samples)
+        assert series[-1][1]["requests_completed"] == 17.0
+
+    def test_gauges_max_min_sum_over_live_sources_only(self):
+        samples = [
+            _sample(1.0, "r0", gauges={"queue_depth": 3}),
+            _sample(1.0, "r1", gauges={"queue_depth": 5}),
+            _sample(1.0, "r2", up=False, gauges={"queue_depth": 99}),
+        ]
+        (_, vals), = fleet_series(samples)
+        assert vals["queue_depth"] == 5.0          # worst-of-fleet
+        assert vals["queue_depth_min"] == 3.0
+        assert vals["queue_depth_sum"] == 8.0      # frozen r2 not a vote
+        assert vals["fleet_up"] == 2.0 and vals["fleet_sources"] == 3.0
+        assert vals["replicas_total"] == 3.0
+        assert vals["replicas_live"] == 2.0
+
+    def test_timing_sum_count_merge_exactly_and_mean_derives(self):
+        samples = [
+            _sample(1.0, "r0", timings={
+                "ttft_s": {"count": 10, "sum": 2.0, "p50_s": 0.2,
+                           "p95_s": 0.3, "p99_s": 0.4},
+            }),
+            _sample(1.0, "r1", timings={
+                "ttft_s": {"count": 30, "sum": 3.0, "p50_s": 0.1,
+                           "p95_s": 0.2, "p99_s": 0.2},
+            }),
+        ]
+        (_, vals), = fleet_series(samples)
+        assert vals["ttft_s_count"] == 40.0
+        assert vals["ttft_s_sum"] == pytest.approx(5.0)
+        assert vals["ttft_s_mean_s"] == pytest.approx(0.125)
+        # merged p95 lands between the sources' p95s
+        assert 0.2 <= vals["ttft_s_p95_s"] <= 0.3 + 1e-6
+
+    def test_timing_count_sum_survive_source_reset(self):
+        samples = [
+            _sample(1.0, "r0", timings={
+                "ttft_s": {"count": 100, "sum": 10.0, "p50_s": 0.1},
+            }),
+            # respawn: reservoir restarted from zero
+            _sample(2.0, "r0", timings={
+                "ttft_s": {"count": 4, "sum": 0.4, "p50_s": 0.1},
+            }),
+        ]
+        series = fleet_series(samples)
+        assert series[-1][1]["ttft_s_count"] == 104.0
+        assert series[-1][1]["ttft_s_sum"] == pytest.approx(10.4)
+
+    def test_fleet_availability_burns_through_slo_evaluate(self, tmp_path):
+        from progen_tpu.telemetry.slo import evaluate
+
+        slo_toml = tmp_path / "slo.toml"
+        slo_toml.write_text(FLEET_SLO_TOML)
+        cfg = load_objectives(slo_toml)
+        samples = []
+        for t in range(0, 610, 10):
+            dead = t >= 300
+            samples.append(_sample(float(t), "r0", up=not dead))
+            samples.append(_sample(float(t), "r1"))
+        series = fleet_series(samples)
+        assert series[-1][1]["replicas_live"] == 1.0
+        (res,) = evaluate(cfg, [series], now=600.0)
+        assert res.state == "burning"
+        assert res.burn_short >= 2.0 and res.burn_long >= 2.0
+
+    def test_latest_by_source(self):
+        samples = [
+            _sample(1.0, "r0"), _sample(2.0, "r0", up=False),
+            _sample(1.5, "r1"),
+        ]
+        latest = latest_by_source(samples)
+        assert latest["r0"]["up"] == 0 and latest["r0"]["ts"] == 2.0
+        assert latest["r1"]["ts"] == 1.5
+
+
+class TestMergeQuantiles:
+    A = {"p50_s": 0.9, "p95_s": 1.0, "p99_s": 1.1}
+    B = {"p50_s": 2.9, "p95_s": 3.0, "p99_s": 3.1}
+
+    def test_identical_parts_merge_to_themselves(self):
+        out = merge_quantiles([(10.0, self.A), (10.0, self.A)])
+        for k, v in self.A.items():
+            assert out[k] == pytest.approx(v, abs=0.02)
+
+    def test_disjoint_parts_bounded_by_slowest(self):
+        out = merge_quantiles([(10.0, self.A), (10.0, self.B)])
+        assert self.A["p50_s"] <= out["p50_s"] <= self.B["p50_s"]
+        assert out["p99_s"] <= self.B["p99_s"] + 1e-6
+        assert out["p95_s"] >= self.B["p50_s"] - 0.2  # upper half is B's
+
+    def test_count_weighting_matters(self):
+        heavy_a = merge_quantiles([(99.0, self.A), (1.0, self.B)])
+        heavy_b = merge_quantiles([(1.0, self.A), (99.0, self.B)])
+        assert heavy_a["p50_s"] <= self.A["p99_s"] + 0.02
+        assert heavy_b["p50_s"] >= self.B["p50_s"] - 0.2
+        assert heavy_b["p50_s"] > heavy_a["p50_s"]
+
+    def test_zero_weight_and_empty_parts_ignored(self):
+        assert merge_quantiles([]) == {}
+        assert merge_quantiles([(0.0, self.A)]) == {}
+        out = merge_quantiles([(5.0, self.A), (0.0, self.B)])
+        assert out["p95_s"] == pytest.approx(
+            self.A["p95_s"], abs=0.02
+        )
+
+
+class TestConsoleSnapshot:
+    def _store(self, tmp_path):
+        db = RingTSDB(tmp_path / "tsdb")
+        for t in (1.0, 2.0):
+            db.append(_sample(
+                t, "r0",
+                counters={"requests_completed": 10 * t},
+                gauges={"queue_depth": 2.0, "slot_occupancy": 1.0},
+                timings={"ttft_s": {"count": 4, "sum": 0.8,
+                                    "p50_s": 0.2, "p95_s": 0.3,
+                                    "p99_s": 0.3}},
+            ))
+            db.append(_sample(
+                t, "r1",
+                counters={"requests_completed": 5 * t},
+                gauges={"queue_depth": 4.0},
+            ))
+        return db
+
+    def test_snapshot_totals_equal_sum_of_sources(self, tmp_path):
+        from progen_tpu.telemetry.console import build_snapshot
+
+        db = self._store(tmp_path)
+        snap = build_snapshot(db)
+        assert snap["as_of"] == 2.0
+        assert [s["name"] for s in snap["sources"]] == ["r0", "r1"]
+        per_source = sum(
+            s["counters"]["requests_completed"] for s in snap["sources"]
+        )
+        assert snap["fleet"]["requests_completed"] == per_source == 30.0
+        assert snap["fleet"]["replicas_live"] == 2.0
+        assert snap["tsdb"]["blocks"] == 1
+        assert snap["tsdb"]["dropped_lines"] == 0
+        db.close()
+
+    def test_render_and_json_forms(self, tmp_path):
+        from progen_tpu.telemetry.console import (
+            build_snapshot, render, snapshot_json,
+        )
+
+        db = self._store(tmp_path)
+        snap = build_snapshot(db)
+        text = render(snap, color=False)
+        assert "progen-tpu-top" in text and "r0" in text and "r1" in text
+        assert "fleet: replicas 2/2 live" in text
+        assert "\x1b[" not in text  # --no-color really is plain
+        assert "\x1b[" in render(snap, color=True)
+        parsed = json.loads(snapshot_json(snap))
+        assert parsed["fleet"]["requests_completed"] == 30.0
+        db.close()
+
+    def test_snapshot_includes_slo_and_alerts(self, tmp_path):
+        from progen_tpu.telemetry.console import build_snapshot
+
+        slo_toml = tmp_path / "slo.toml"
+        slo_toml.write_text(FLEET_SLO_TOML)
+        sink = AlertSink(tmp_path / "alerts.jsonl")
+        sink.staleness(source="r9", up=False, age_s=42.0, now=2.0)
+        sink.close()
+        db = self._store(tmp_path)
+        snap = build_snapshot(
+            db, slo_cfg=load_objectives(slo_toml),
+            alerts_path=tmp_path / "alerts.jsonl",
+        )
+        assert snap["slo_exit"] == 0, snap["slo"]
+        assert snap["slo"][0]["objective"] == "fleet_availability"
+        assert snap["alerts"][-1]["source"] == "r9"
+        db.close()
+
+
+class TestCollectorConfig:
+    def test_load_settings_and_sources(self, tmp_path):
+        cfg = tmp_path / "collector.toml"
+        cfg.write_text(
+            "[collector]\ninterval_s = 1.5\nstale_after_s = 7.0\n"
+            "budget_bytes = 4096\n\n"
+            '[source_r0]\nrole = "replica"\nprom = "/tmp/r0.prom"\n\n'
+            '[source_router]\nrole = "router"\nprom = "/tmp/router.prom"\n'
+            'metrics = "/tmp/m.jsonl"\n'
+        )
+        settings, sources = load_collector_config(cfg)
+        assert settings["interval_s"] == 1.5
+        assert settings["budget_bytes"] == 4096
+        names = {s.name: s for s in sources}
+        assert set(names) == {"r0", "router"}
+        assert names["router"].role == "router"
+        assert names["router"].metrics == "/tmp/m.jsonl"
+
+    def test_shipped_example_parses(self):
+        settings, sources = load_collector_config(
+            REPO / "configs" / "serving" / "collector.toml"
+        )
+        assert settings["interval_s"] > 0
+        assert {s.role for s in sources} == {"replica", "router"}
+        assert len(sources) == 3
+
+
+class TestCollectorCli:
+    def _invoke(self, cli, args):
+        return CliRunner().invoke(cli, args)
+
+    def test_once_scrapes_and_exits_zero(self, tmp_path):
+        import time as _t
+
+        from progen_tpu.cli.collector import main as collector_cli
+
+        prom = _write_prom(
+            tmp_path / "r0.prom", _serving_metrics(), mtime=_t.time()
+        )
+        res = self._invoke(collector_cli, [
+            "--tsdb", str(tmp_path / "tsdb"),
+            "--source", f"name=r0,role=replica,prom={prom}",
+            "--once",
+        ])
+        assert res.exit_code == 0, res.output
+        recs = list(TsdbReader(tmp_path / "tsdb").read())
+        assert len(recs) == 1 and recs[0]["source"] == "r0"
+        assert recs[0]["up"] == 1
+
+    def test_max_ticks_and_alerts_default_path(self, tmp_path):
+        from progen_tpu.cli.collector import main as collector_cli
+
+        prom = _write_prom(
+            tmp_path / "r0.prom", _serving_metrics(), mtime=0.0
+        )
+        res = self._invoke(collector_cli, [
+            "--tsdb", str(tmp_path / "tsdb"),
+            "--source", f"name=r0,prom={prom}",
+            "--interval", "0.01", "--max-ticks", "3",
+        ])
+        assert res.exit_code == 0, res.output
+        recs = list(TsdbReader(tmp_path / "tsdb").read())
+        assert len(recs) == 3
+
+    def test_no_sources_is_usage_error(self, tmp_path):
+        from progen_tpu.cli.collector import main as collector_cli
+
+        res = self._invoke(
+            collector_cli, ["--tsdb", str(tmp_path / "tsdb")]
+        )
+        assert res.exit_code == 2
+
+    def test_bad_source_spec_is_usage_error(self, tmp_path):
+        from progen_tpu.cli.collector import main as collector_cli
+
+        res = self._invoke(collector_cli, [
+            "--tsdb", str(tmp_path / "tsdb"), "--source", "prom=/p",
+        ])
+        assert res.exit_code == 2
+
+
+class TestTopCli:
+    def _store(self, tmp_path):
+        db = RingTSDB(tmp_path / "tsdb")
+        for src, done in (("r0", 10), ("r1", 7)):
+            db.append(_sample(
+                1.0, src, counters={"requests_completed": done},
+                gauges={"queue_depth": 1.0},
+            ))
+        db.close()
+        return tmp_path / "tsdb"
+
+    def test_once_json_is_the_snapshot(self, tmp_path):
+        from progen_tpu.cli.top import main as top_cli
+
+        store = self._store(tmp_path)
+        res = CliRunner().invoke(
+            top_cli, ["--tsdb", str(store), "--once", "--json"]
+        )
+        assert res.exit_code == 0, res.output
+        snap = json.loads(res.output)
+        assert {s["name"]: s["up"] for s in snap["sources"]} == {
+            "r0": True, "r1": True
+        }
+        assert snap["fleet"]["requests_completed"] == 17.0
+
+    def test_once_renders_dashboard(self, tmp_path):
+        from progen_tpu.cli.top import main as top_cli
+
+        store = self._store(tmp_path)
+        res = CliRunner().invoke(
+            top_cli, ["--tsdb", str(store), "--once", "--no-color"]
+        )
+        assert res.exit_code == 0, res.output
+        assert "progen-tpu-top" in res.output and "r0" in res.output
+
+    def test_json_without_once_rejected(self, tmp_path):
+        from progen_tpu.cli.top import main as top_cli
+
+        store = self._store(tmp_path)
+        res = CliRunner().invoke(top_cli, ["--tsdb", str(store), "--json"])
+        assert res.exit_code == 2
+
+
+class TestSloReportTsdb:
+    def _objectives(self, tmp_path):
+        p = tmp_path / "slo.toml"
+        p.write_text(FLEET_SLO_TOML)
+        return p
+
+    def _store(self, tmp_path, kill_at=None):
+        db = RingTSDB(tmp_path / "tsdb")
+        for t in range(0, 610, 10):
+            dead = kill_at is not None and t >= kill_at
+            db.append(_sample(float(t), "r0", up=not dead))
+            db.append(_sample(float(t), "r1"))
+        db.close()
+        return tmp_path / "tsdb"
+
+    def test_clean_fleet_exits_zero(self, tmp_path):
+        from progen_tpu.cli.telemetry import main as telemetry_cli
+
+        res = CliRunner().invoke(telemetry_cli, [
+            "slo-report",
+            "--objectives", str(self._objectives(tmp_path)),
+            "--tsdb", str(self._store(tmp_path)),
+        ])
+        assert res.exit_code == 0, res.output
+        assert "SLO report" in res.output
+
+    def test_replica_loss_burns_and_exits_two(self, tmp_path):
+        from progen_tpu.cli.telemetry import main as telemetry_cli
+
+        out = tmp_path / "report.json"
+        res = CliRunner().invoke(telemetry_cli, [
+            "slo-report",
+            "--objectives", str(self._objectives(tmp_path)),
+            "--tsdb", str(self._store(tmp_path, kill_at=300)),
+            "--json", str(out),
+        ])
+        assert res.exit_code == 2, res.output
+        payload = json.loads(out.read_text())
+        assert payload["exit"] == 2
+        (r,) = payload["results"]
+        assert r["objective"] == "fleet_availability"
+        assert r["state"] == "burning"

@@ -179,6 +179,30 @@ class TestMetrics:
         assert m["decode_tokens_per_s"] > 0
         assert m["prefill_tokens_per_s"] > 0
 
+    def test_occupancy_and_compile_gauges(self, model_and_params):
+        model, params = model_and_params
+        engine = ServeEngine(model, params, max_slots=2, max_len=32)
+        metrics = ServingMetrics()
+        sched = Scheduler(engine, max_queue=4, metrics=metrics)
+        # published from construction so the first exposition already
+        # carries the fleet-scrape gauges
+        m = metrics.snapshot()
+        assert m["slot_occupancy"] == 0 and m["slots_free"] == 2
+        assert "decode_compile_count" in m
+        assert "prefill_compile_count" in m
+        for i in range(2):
+            assert sched.submit(_req(i, length=8))[0]
+        sched.step()
+        m = metrics.snapshot()
+        assert m["slot_occupancy"] >= 1
+        assert m["slots_free"] == 2 - m["slot_occupancy"]
+        sched.run_to_completion(max_steps=300)
+        m = metrics.snapshot()
+        assert m["slot_occupancy"] == 0 and m["slots_free"] == 2
+        # the steps above decoded, so at least one decode compile has
+        # been published at step cadence
+        assert m["decode_compile_count"] >= 1
+
     def test_log_to_tracker(self, model_and_params, tmp_path):
         from progen_tpu.tracking import JsonlTracker
 

@@ -260,6 +260,68 @@ def test_timing_quantiles_deterministic():
     assert a.quantile(0.99) == b.quantile(0.99)
 
 
+def test_timing_stats_carry_mergeable_sum():
+    # fleet averages are only mergeable from (sum, count) pairs — the
+    # collector's aggregation depends on this key (see prometheus.py's
+    # exposition contract)
+    from progen_tpu.serving.metrics import _Timing
+
+    t = _Timing()
+    for v in (0.1, 0.2, 0.3):
+        t.observe(v)
+    s = t.stats()
+    assert s["sum"] == pytest.approx(0.6)
+    assert s["mean_s"] == pytest.approx(s["sum"] / s["count"])
+    assert _Timing().stats()["sum"] == 0.0
+
+
+def test_timing_merged_exact_moments_and_close_quantiles():
+    from progen_tpu.serving.metrics import _Timing
+
+    a, b, ref = _Timing(), _Timing(), _Timing()
+    for i in range(1500):
+        v = i / 1500.0  # fast source: [0, 1)
+        a.observe(v)
+        ref.observe(v)
+    for i in range(500):
+        v = 2.0 + i / 500.0  # slow source: [2, 3)
+        b.observe(v)
+        ref.observe(v)
+    m = _Timing.merged([a, b])
+    # moments merge exactly regardless of reservoir sampling
+    assert m.count == ref.count == 2000
+    assert m.sum == pytest.approx(ref.sum)
+    assert m.min == ref.min and m.max == ref.max
+    # quantiles merge approximately, tracking the combined stream: the
+    # 3:1 count weighting must place p50 in the fast source's range
+    # even though both reservoirs hold the same number of slots
+    assert m.quantile(0.5) == pytest.approx(ref.quantile(0.5), abs=0.2)
+    assert m.quantile(0.5) < 1.0
+    assert m.quantile(0.95) == pytest.approx(ref.quantile(0.95), abs=0.25)
+    assert m.quantile(0.95) > 2.0
+
+
+def test_timing_merged_edge_cases():
+    from progen_tpu.serving.metrics import _Timing
+
+    assert _Timing.merged([]).count == 0
+    empty = _Timing()
+    solo = _Timing()
+    for v in (0.5, 1.5):
+        solo.observe(v)
+    m = _Timing.merged([solo, empty])
+    assert m.count == 2 and m.sum == pytest.approx(2.0)
+    assert m.quantile(0.99) == solo.quantile(0.99)
+    # merging is deterministic (seeded subsampling)
+    big = [_Timing() for _ in range(3)]
+    for j, t in enumerate(big):
+        for i in range(400):
+            t.observe(j + i / 400.0)
+    q1 = _Timing.merged(big).quantile(0.95)
+    q2 = _Timing.merged(big).quantile(0.95)
+    assert q1 == q2
+
+
 # ------------------------------------------------------ StepTimer fixes
 
 

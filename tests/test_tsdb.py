@@ -1,0 +1,186 @@
+"""Ring-buffer TSDB (telemetry/tsdb.py): block rotation, the byte
+budget (downsample before drop), torn-tail recovery, merge_pair
+semantics, and the read-only TsdbReader the console and slo-report
+open against a live store."""
+
+import json
+
+from progen_tpu.telemetry.tsdb import RingTSDB, TsdbReader, merge_pair
+from progen_tpu.telemetry.trace import LineDrops
+
+
+def _rec(ts, source="a", up=1, **extra):
+    # neutral ev tag: real ev:"sample" records are make_sample()'s job
+    out = {"ev": "s", "ts": float(ts), "source": source, "up": up}
+    out.update(extra)
+    return out
+
+
+class TestMergePair:
+    def test_later_record_wins_wholesale(self):
+        a = _rec(1.0, v=10, only_a=1)
+        b = _rec(2.0, v=20)
+        out = merge_pair(a, b)
+        assert out["ts"] == 2.0 and out["v"] == 20
+        assert "only_a" not in out  # cumulative: dropping a loses nothing
+
+    def test_n_tally_accumulates(self):
+        a, b = _rec(1.0), _rec(2.0)
+        assert merge_pair(a, b)["n"] == 2
+        c = merge_pair(merge_pair(a, b), _rec(3.0))
+        assert c["n"] == 3
+
+    def test_up_keeps_worst_of_pair(self):
+        assert merge_pair(_rec(1.0, up=0), _rec(2.0, up=1))["up"] == 0
+        assert merge_pair(_rec(1.0, up=1), _rec(2.0, up=0))["up"] == 0
+        assert merge_pair(_rec(1.0, up=1), _rec(2.0, up=1))["up"] == 1
+
+
+class TestAppendRead:
+    def test_roundtrip_in_order(self, tmp_path):
+        db = RingTSDB(tmp_path / "tsdb")
+        for i in range(20):
+            db.append(_rec(i, v=i))
+        got = list(db.read())
+        assert [r["v"] for r in got] == list(range(20))
+        db.close()
+
+    def test_blocks_rotate_at_block_bytes(self, tmp_path):
+        db = RingTSDB(tmp_path / "tsdb", block_bytes=512,
+                      budget_bytes=1 << 20)
+        for i in range(100):
+            db.append(_rec(i))
+        blocks = db.blocks()
+        assert len(blocks) > 1
+        # sealed blocks respect the size cap (±1 line of slop)
+        for b in blocks[:-1]:
+            assert b["bytes"] >= 512
+        assert [r["ts"] for r in db.read()] == [float(i) for i in range(100)]
+        db.close()
+
+    def test_reopen_appends_to_active_block(self, tmp_path):
+        root = tmp_path / "tsdb"
+        db = RingTSDB(root, block_bytes=1 << 20)
+        db.append(_rec(1))
+        db.close()
+        db2 = RingTSDB(root, block_bytes=1 << 20)
+        db2.append(_rec(2))
+        assert len(db2.blocks()) == 1
+        assert [r["ts"] for r in db2.read()] == [1.0, 2.0]
+        db2.close()
+
+
+class TestRingBound:
+    def test_long_ingest_stays_under_budget_via_downsampling(self, tmp_path):
+        budget, block = 8192, 1024
+        db = RingTSDB(tmp_path / "tsdb", budget_bytes=budget,
+                      block_bytes=block, max_level=4)
+        for i in range(2000):
+            db.append(_rec(i, source="r0", counters={"done": i}))
+        # the budget is enforced at seal time, so the worst case is the
+        # budget plus one active block still filling
+        assert db.total_bytes() <= budget + block
+        levels = {b["level"] for b in db.blocks()}
+        assert max(levels) > 0, "ring never downsampled"
+        recs = list(db.read())
+        assert recs, "ring dropped everything"
+        # downsampled records carry the tally of raw samples they stand
+        # for, and the newest records survive at full resolution
+        assert any(r.get("n", 1) > 1 for r in recs)
+        assert recs[-1]["ts"] == 1999.0
+        db.close()
+
+    def test_downsample_pairs_within_source_and_keeps_worst_up(self, tmp_path):
+        db = RingTSDB(tmp_path / "tsdb", budget_bytes=1 << 20,
+                      block_bytes=1 << 20)
+        for i in range(10):
+            db.append(_rec(i, source="r0", up=1 if i != 4 else 0))
+            db.append(_rec(i, source="r1", up=1))
+        # force one compaction pass directly
+        seq, level, path = db._scan()[0]
+        db._downsample(seq, level, path)
+        recs = list(db.read())
+        by_src = {}
+        for r in recs:
+            by_src.setdefault(r["source"], []).append(r)
+        assert len(by_src["r0"]) == 5 and len(by_src["r1"]) == 5
+        assert all(r["n"] == 2 for r in recs)
+        # the down sample at ts=4 merged into a pair that keeps up=0
+        assert sum(1 for r in by_src["r0"] if r["up"] == 0) == 1
+        assert all(r["up"] == 1 for r in by_src["r1"])
+        # filename level bumped, seq preserved
+        assert db.blocks()[0]["level"] == level + 1
+        db.close()
+
+    def test_max_level_blocks_are_deleted_oldest_first(self, tmp_path):
+        db = RingTSDB(tmp_path / "tsdb", budget_bytes=2048,
+                      block_bytes=1024, max_level=0)
+        for i in range(800):
+            db.append(_rec(i))
+        # max_level=0 means no resolution left to trade: the ring wraps
+        assert db.total_bytes() <= 2048 + 1024
+        recs = list(db.read())
+        assert recs and recs[0]["ts"] > 0.0  # oldest history gone
+        assert recs[-1]["ts"] == 799.0  # newest intact
+        db.close()
+
+
+class TestTornTail:
+    def test_torn_final_line_truncated_and_counted(self, tmp_path):
+        root = tmp_path / "tsdb"
+        db = RingTSDB(root)
+        for i in range(5):
+            db.append(_rec(i))
+        db.close()
+        # SIGKILL mid-write: a partial final line with no newline
+        seq, level, path = TsdbReader(root)._scan()[-1]
+        with path.open("a") as f:
+            f.write('{"ev":"s","ts":99,"tr')
+        db2 = RingTSDB(root)
+        assert db2.dropped_lines == 1
+        recs = list(db2.read())
+        assert [r["ts"] for r in recs] == [0.0, 1.0, 2.0, 3.0, 4.0]
+        db2.append(_rec(5))
+        assert [r["ts"] for r in db2.read()][-1] == 5.0
+        db2.close()
+
+    def test_garbage_interior_line_skipped_and_tallied(self, tmp_path):
+        root = tmp_path / "tsdb"
+        db = RingTSDB(root)
+        db.append(_rec(0))
+        db.close()
+        path = TsdbReader(root)._scan()[0][2]
+        with path.open("a") as f:
+            f.write("not json at all\n")
+            f.write(json.dumps(_rec(1)) + "\n")
+        drops = LineDrops()
+        recs = list(TsdbReader(root).read(drops))
+        assert [r["ts"] for r in recs] == [0.0, 1.0]
+        assert drops.count == 1
+
+
+class TestTsdbReader:
+    def test_reader_matches_writer_and_never_mutates(self, tmp_path):
+        root = tmp_path / "tsdb"
+        db = RingTSDB(root, block_bytes=512)
+        for i in range(50):
+            db.append(_rec(i))
+        rd = TsdbReader(root)
+        assert [r["ts"] for r in rd.read()] == [r["ts"] for r in db.read()]
+        assert rd.total_bytes() == db.total_bytes()
+        assert rd.blocks() == db.blocks()
+        db.close()
+        # reader leaves a torn tail ON DISK (the writer owns recovery)
+        path = rd._scan()[-1][2]
+        before = path.read_bytes()
+        with path.open("a") as f:
+            f.write('{"torn')
+        drops = LineDrops()
+        recs = list(TsdbReader(root).read(drops))
+        assert len(recs) == 50 and drops.count == 1
+        assert path.read_bytes() == before + b'{"torn'
+
+    def test_missing_directory_reads_empty(self, tmp_path):
+        rd = TsdbReader(tmp_path / "never_created")
+        assert list(rd.read()) == []
+        assert rd.total_bytes() == 0 and rd.blocks() == []
