@@ -185,6 +185,11 @@ _PHASES = (
     # serving engine under staggered arrivals (steady-state tokens/s +
     # TTFT); two jits only, shapes shared with decode-tiny's policy
     ("decode-serve", 600),
+    # admission stall under mixed traffic: decode ITL p99 while a long
+    # prompt admits, monolithic vs chunked, plus the prefix-cache TTFT
+    # speedup — the two gated serving ratios (bench.py gate --metric
+    # serve_admit_stall_ratio / serve_prefix_cache_speedup)
+    ("decode-admit-stall", 600),
     # int8 weight-quantized decode vs fp on the same params (quant
     # compile cost rides the engine build; two decode jits total)
     ("decode-int8", 600),
@@ -1400,6 +1405,193 @@ def _decode_serve_bench() -> dict:
     }
 
 
+def _decode_admit_stall_bench() -> dict:
+    """The admission-stall number the chunked-prefill work exists to
+    move: decode ITL p99 for live requests WHILE a long prompt admits.
+
+    Two runs of the same scenario — live decoders, then a long-prime
+    request submitted mid-flight — one on the monolithic scheduler
+    (``prefill_chunk=0``: the whole prefill lands inside one step, and
+    every live decoder's next token waits behind it) and one chunked
+    (at most ``chunk`` prime tokens between decode steps). Headline
+    ``value`` = monolithic ITL p99 / chunked ITL p99 — dimensionless,
+    >1 means chunking wins, and the bench gate ratchets it
+    (``--metric serve_admit_stall_ratio``).
+
+    Second number: ``prefix_cache_speedup`` = cold TTFT / cache-hit
+    TTFT for the same scaffold on a quiet engine (``--metric
+    serve_prefix_cache_speedup``). Both are ratios of host-observed
+    wall clock on the SAME process/platform, so they are honest on CPU
+    smoke shapes too — which is why tier1.yml can enforce them."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from flax import linen as nn
+
+    from progen_tpu.models.progen import ProGen
+    from progen_tpu.serving import (
+        PrefixCache,
+        Request,
+        Scheduler,
+        ServeEngine,
+    )
+
+    on_tpu = _is_tpu_platform(jax.devices()[0].platform)
+    # longer window than the other smoke phases: the signal IS the
+    # admission stall, and on CPU per-step dispatch overhead (~1-2 ms)
+    # would swamp a short prime's prefill. ~270 feed positions makes
+    # the monolithic stall step several times a decode step.
+    config = (
+        _load_config("tiny", seq_len=512)
+        if on_tpu
+        else _load_config("smoke", seq_len=384)
+    )
+    chunk = 16 if on_tpu else 8
+    n_decoders = 3
+    repeats = 3
+    model = ProGen(config)
+    tokens = jnp.zeros((1, config.seq_len), jnp.int32)
+    params = nn.meta.unbox(
+        jax.jit(model.init)(jax.random.PRNGKey(0), tokens)["params"]
+    )
+    rng = np.random.RandomState(7)
+    # the admission under test: a prime filling ~70% of the window, so
+    # its monolithic prefill dwarfs one decode step
+    long_prime = rng.randint(
+        1, config.num_tokens, size=int(config.seq_len * 0.7)
+    ).astype(np.int32)
+    short_prime = rng.randint(1, config.num_tokens, size=6).astype(np.int32)
+
+    # fixed-size measurement window covering the WHOLE admission on both
+    # paths (monolithic admits in one step; chunked across ~prime/chunk
+    # steps) — identical sample counts keep the two p99s comparable
+    window = max(28, len(long_prime) // chunk + 8)
+
+    def _measure(prefill_chunk, prefix_cache):
+        """ITL samples (s) for live decoders across the admission
+        window of the long request, on a fresh engine+scheduler."""
+        engine = ServeEngine(model, params, max_slots=n_decoders + 1,
+                             max_len=config.seq_len)
+        sched = Scheduler(engine, max_queue=16,
+                          prefill_chunk=prefill_chunk,
+                          prefix_cache=prefix_cache)
+        # warmup pays this path's full compile set (prefill or
+        # chunk+finish, plus decode) outside the measured window
+        ok, _ = sched.submit(Request(
+            id="warm", prime=long_prime[:12], length=20,
+            key=jax.random.PRNGKey(0),
+        ))
+        assert ok
+        sched.run_to_completion(max_steps=4000)
+        # decoders live through the window plus slack, no longer — the
+        # post-measurement drain is dead time
+        dec_len = min(int(config.seq_len) - 2,
+                      len(short_prime) + 1 + window + 24)
+        for i in range(n_decoders):
+            ok, reason = sched.submit(Request(
+                id=f"dec{i}", prime=short_prime, length=dec_len,
+                key=jax.random.PRNGKey(100 + i),
+            ))
+            assert ok, reason
+        for _ in range(6):  # decoders provably in steady state
+            sched.step()
+        ok, reason = sched.submit(Request(
+            id="long", prime=long_prime,
+            length=len(long_prime) + 16,
+            key=jax.random.PRNGKey(999),
+        ))
+        assert ok, reason
+        itl = []
+        admitted = False
+        while len(itl) < window:
+            t0 = time.perf_counter()
+            sched.step()
+            itl.append(time.perf_counter() - t0)
+            admitted = admitted or not (
+                sched._queue or sched._pending is not None
+            )
+        assert admitted, "window too short: admission never completed"
+        sched.run_to_completion(max_steps=20000)
+        return itl
+
+    # interleaved repeats, median of per-repeat p99s: one stall sample
+    # against a machine-noise p99 would be a coin flip on a busy CPU
+    # runner; the median of three interleaved pairs is not
+    p99s_mono, p99s_chunk = [], []
+    itl_mono, itl_chunk = [], []
+    for rep in range(repeats):
+        _mark(f"admit-stall: repeat {rep + 1}/{repeats} monolithic")
+        itl = _measure(0, None)
+        p99s_mono.append(float(np.percentile(itl, 99)))
+        itl_mono.extend(itl)
+        _mark(f"admit-stall: repeat {rep + 1}/{repeats} chunked")
+        itl = _measure(chunk, None)
+        p99s_chunk.append(float(np.percentile(itl, 99)))
+        itl_chunk.extend(itl)
+    p99_mono = float(np.median(p99s_mono))
+    p99_chunk = float(np.median(p99s_chunk))
+    stall_ratio = p99_mono / max(p99_chunk, 1e-9)
+    _mark(f"admit-stall: p99 mono={p99_mono:.4f}s "
+          f"chunk={p99_chunk:.4f}s ratio={stall_ratio:.2f}")
+
+    # prefix-cache TTFT: same scaffold cold then hot on a quiet engine.
+    # Same max_slots as the measurement engines — the finish program's
+    # pool shape stays cached, so cold TTFT is admission cost, not a
+    # recompile
+    cache = PrefixCache(256 << 20)
+    engine = ServeEngine(model, params, max_slots=n_decoders + 1,
+                         max_len=config.seq_len)
+    sched = Scheduler(engine, max_queue=4, prefill_chunk=chunk,
+                      prefix_cache=cache)
+
+    def _ttft(rid):
+        ok, reason = sched.submit(Request(
+            id=rid, prime=long_prime, length=len(long_prime) + 12,
+            key=jax.random.PRNGKey(1234),
+        ))
+        assert ok, reason
+        t0 = time.perf_counter()
+        while True:
+            ev, _ = sched.step()
+            if any(e.request_id == rid for e in ev):
+                ttft = time.perf_counter() - t0
+                break
+        sched.run_to_completion(max_steps=20000)
+        return ttft
+
+    # compile warmup for THIS engine already paid: same jits, same
+    # shapes as the measurement engines above (process-level jit cache)
+    ttft_cold = _ttft("cold")
+    ttft_hit = _ttft("hot")
+    speedup = ttft_cold / max(ttft_hit, 1e-9)
+    st = cache.stats()
+    _mark(f"admit-stall: ttft cold={ttft_cold:.3f}s hit={ttft_hit:.3f}s "
+          f"speedup={speedup:.2f} (cache hits={st['hits']})")
+
+    return {
+        "phase": "decode-admit-stall",
+        "metric": "serve_admit_stall_ratio",
+        "value": round(stall_ratio, 3),
+        "prefix_cache_speedup": round(speedup, 3),
+        "config": "tiny-seq512" if on_tpu else "smoke",
+        "prefill_chunk": chunk,
+        "prime_tokens": int(len(long_prime)),
+        "n_decoders": n_decoders,
+        "itl_p99_monolithic_s": round(p99_mono, 5),
+        "itl_p99_chunked_s": round(p99_chunk, 5),
+        "itl_mean_monolithic_s": round(float(np.mean(itl_mono)), 5),
+        "itl_mean_chunked_s": round(float(np.mean(itl_chunk)), 5),
+        "ttft_cold_s": round(ttft_cold, 4),
+        "ttft_hit_s": round(ttft_hit, 4),
+        "prefix_cache_hits": int(st["hits"]),
+        "prefix_cache_hit_tokens": int(
+            sched.metrics.snapshot().get("prefix_cache_hit_tokens", 0)
+        ),
+        "platform": jax.devices()[0].platform,
+        **_hbm_stats(),
+    }
+
+
 def _decode_int8_bench() -> dict:
     """Int8 weight-quantized decode (ops/quant.py, --int8 on the serve
     CLI) vs the full-precision engine built from the SAME params: decode
@@ -1860,6 +2052,8 @@ def run_phase(name: str) -> dict:
         return _decode_bench()
     if name == "decode-serve":
         return _decode_serve_bench()
+    if name == "decode-admit-stall":
+        return _decode_admit_stall_bench()
     if name == "decode-int8":
         return _decode_int8_bench()
     if name == "batch-score":
@@ -2145,6 +2339,18 @@ def main() -> None:
                 "kv_tps": res["kv_cache_tokens_per_sec"],
                 "speedup": res["speedup"],
             }
+        elif ph == "decode-admit-stall":
+            summary[ph] = {
+                "stall_ratio": res["value"],
+                "prefix_cache_speedup": res["prefix_cache_speedup"],
+            }
+            # carry both serving ratios on the headline so the gate
+            # chains see them even in rounds whose parsed metric is the
+            # train number (the last_tpu_record idiom)
+            headline["serve_admit_stall_ratio"] = res["value"]
+            headline["serve_prefix_cache_speedup"] = res[
+                "prefix_cache_speedup"
+            ]
         elif ph == "decode-int8":
             summary[ph] = {
                 "int8_tps": res["int8_tokens_per_sec"],
@@ -2185,15 +2391,22 @@ def gate_main(argv: list) -> int:
     usage errors — the contract tier1.yml enforces."""
     import argparse
 
+    from progen_tpu.utils.bench_gate import SERVE_CHAINS, run_gate
+
     ap = argparse.ArgumentParser(prog="bench.py gate")
     ap.add_argument("--value", type=float, default=None)
     ap.add_argument("--from-json", default=None)
-    ap.add_argument("--metric", choices=("cpu", "tpu", "auto"),
+    ap.add_argument(
+        "--from-json-key", default="value",
+        help="key to read from --from-json (default 'value'; e.g. "
+             "'prefix_cache_speedup' from the decode-admit-stall phase "
+             "JSON, which carries two gated numbers in one record)",
+    )
+    ap.add_argument("--metric",
+                    choices=("cpu", "tpu", "auto") + SERVE_CHAINS,
                     default="cpu")
     ap.add_argument("--tolerance", type=float, default=0.2)
     args = ap.parse_args(argv)
-
-    from progen_tpu.utils.bench_gate import run_gate
 
     if args.value is not None:
         value, source = args.value, "--value"
@@ -2204,15 +2417,16 @@ def gate_main(argv: list) -> int:
             print(f"gate: cannot read {args.from_json}: {e}",
                   file=sys.stderr)
             return 2
-        raw = doc.get("value") if isinstance(doc, dict) else None
+        key = args.from_json_key
+        raw = doc.get(key) if isinstance(doc, dict) else None
         if raw is None and isinstance(doc, dict) \
                 and isinstance(doc.get("parsed"), dict):
-            raw = doc["parsed"].get("value")
+            raw = doc["parsed"].get(key)
         if raw is None:
-            print(f"gate: no 'value' in {args.from_json}",
+            print(f"gate: no {key!r} in {args.from_json}",
                   file=sys.stderr)
             return 2
-        value, source = float(raw), args.from_json
+        value, source = float(raw), f"{args.from_json}:{key}"
     else:
         _force_cpu()
         value, source = _cpu_smoke()["value"], "fresh cpu smoke"

@@ -47,6 +47,10 @@ dashboard query then matches nothing. Three checks:
     ``start``/``resume``/``batch``/``skip``/``done`` — the batch-score
     journal's grammar is the resume/progress contract the CI workloads
     smoke (and ``summarize``) read.
+  * raw ``"ev": "prefix_cache"`` records must not be emitted outside
+    ``serving/prefix_cache.py``, and a literal ``"op"`` must be one of
+    ``hit``/``miss``/``evict`` — cache-reuse accounting (and the CI
+    serving smoke's hit assertion) key on exactly this alphabet.
   * raw ``"ev": "slo"`` records must not be emitted outside
     ``telemetry/slo.py`` — the watchtower's transition grammar is what
     the SLO gate and summarize key on — and a literal ``"state"`` must
@@ -315,6 +319,22 @@ class TelemetryHygieneRule(Rule):
                     "score record 'op'",
                     "an unknown op is invisible to the scoring progress "
                     "tooling and the resume smoke",
+                )
+            elif v.value == "prefix_cache":
+                if not self._in_module("serving/prefix_cache.py"):
+                    self.report(
+                        v,
+                        "raw prefix_cache record emitted outside "
+                        "serving/prefix_cache.py — cache reuse events "
+                        "are what the serving smoke's hit assertion and "
+                        "summarize key on; go through PrefixCache, not "
+                        "hand-rolled records",
+                    )
+                self._check_literal_member(
+                    d, "op", ("hit", "miss", "evict"),
+                    "prefix_cache record 'op'",
+                    "an unknown op is invisible to the cache-reuse "
+                    "accounting and the serving smoke",
                 )
             elif v.value == "slo":
                 if not self._in_module("telemetry/slo.py"):

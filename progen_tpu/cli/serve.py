@@ -207,13 +207,14 @@ def _events_to_lines(events, completions, starts):
 
 
 def _build(checkpoint_path, max_slots, max_len, max_queue,
-           quantize_int8=False, journal=None):
+           quantize_int8=False, journal=None, prefill_chunk=0,
+           prefix_cache_mb=0):
     import os.path
 
     from progen_tpu.checkpoint import get_checkpoint_fns
     from progen_tpu.config import ProGenConfig
     from progen_tpu.models.progen import ProGen
-    from progen_tpu.serving import Scheduler, ServeEngine
+    from progen_tpu.serving import PrefixCache, Scheduler, ServeEngine
 
     _, get_last, _ = get_checkpoint_fns(checkpoint_path)
     pkg = get_last.restore_params()
@@ -235,7 +236,12 @@ def _build(checkpoint_path, max_slots, max_len, max_queue,
             file=sys.stderr,
         )
     ckpt_name = os.path.basename(pkg.path) if pkg.path else None
-    sched = Scheduler(engine, max_queue=max_queue, journal=journal)
+    prefix_cache = None
+    if prefix_cache_mb:
+        prefix_cache = PrefixCache(int(prefix_cache_mb) * (1 << 20))
+    sched = Scheduler(engine, max_queue=max_queue, journal=journal,
+                      prefill_chunk=prefill_chunk,
+                      prefix_cache=prefix_cache)
     return sched, engine, ckpt_name
 
 
@@ -254,6 +260,16 @@ def _build(checkpoint_path, max_slots, max_len, max_queue,
               help="serve int8 weight-quantized matmuls (per-channel "
                    "symmetric, dequant fused on-device); logs a "
                    "max-abs-error calibration report at load")
+@click.option("--prefill_chunk", default=0,
+              help="admit long prompts N prime tokens per decode step "
+                   "(chunked prefill) instead of stalling every live "
+                   "decode for the whole prompt; 0 = monolithic "
+                   "admission. Streams are bit-identical either way")
+@click.option("--prefix_cache_mb", default=0,
+              help="LRU cache of prefill-state snapshots keyed on the "
+                   "token-prefix hash, in MiB of device cache bytes "
+                   "(0 = off): repeated scaffolds skip their shared "
+                   "prefix at admission. Invalidated on hot reload")
 @click.option("--top_k", default=25, help="default per-request top_k")
 @click.option("--temperature", default=1.0,
               help="default per-request temperature")
@@ -294,9 +310,9 @@ def _build(checkpoint_path, max_slots, max_len, max_queue,
                    "hot-reload when a new complete checkpoint appears "
                    "(0 = off; SIGHUP always triggers a reload)")
 def main(checkpoint_path, max_slots, max_queue, max_len, quantize_int8,
-         top_k, temperature, top_p, seed, socket_path, metrics_every,
-         prom_file, prom_port, heartbeat, journal_dir, replay_dir,
-         reload_watch):
+         prefill_chunk, prefix_cache_mb, top_k, temperature, top_p, seed,
+         socket_path, metrics_every, prom_file, prom_port, heartbeat,
+         journal_dir, replay_dir, reload_watch):
     from progen_tpu import telemetry
     from progen_tpu.resilience.chaos import install_from_env
     from progen_tpu.telemetry import (
@@ -319,6 +335,7 @@ def main(checkpoint_path, max_slots, max_queue, max_len, quantize_int8,
     sched, engine, ckpt_name = _build(
         checkpoint_path, max_slots, max_len, max_queue,
         quantize_int8=quantize_int8, journal=journal,
+        prefill_chunk=prefill_chunk, prefix_cache_mb=prefix_cache_mb,
     )
     defaults = {
         "length": engine.max_len, "top_k": top_k,

@@ -34,6 +34,11 @@ Serving targets (the serve kill-matrix, tests/test_serve_kill_matrix):
 
   * ``serve/prefill``        — span entry when a request is admitted
                                (kill here = die mid-prefill);
+  * ``serve/prefill_chunk``  — span entry of each budgeted chunk of a
+                               chunked admission (``kill@N`` = die
+                               mid-chunk with the slot acquired but
+                               never activated; replay must re-run the
+                               whole prefill exactly once);
   * ``serve/decode``         — called by the scheduler once per decode
                                step, before the engine advances
                                (``kill@N`` = die after N-1 full steps);
@@ -82,7 +87,8 @@ KNOWN_TARGETS = frozenset({
     # spans
     "ckpt/finalize", "ckpt/restore", "ckpt/restore_params", "ckpt/save",
     "router/handoff",
-    "serve/prefill", "serve/reload", "serve/reload_commit",
+    "serve/prefill", "serve/prefill_chunk", "serve/reload",
+    "serve/reload_commit",
     "train/ckpt", "train/compile", "train/eval", "train/rollback",
     "train/sample",
     # retry-site labels (resilience/retry.py)

@@ -17,7 +17,13 @@ Scale-out (router.py): a `Router` fans requests across N such engines
 and hands a dead replica's journal-accepted work to survivors.
 """
 
-from progen_tpu.serving.engine import PreparedParams, ServeEngine, SlotBatch
+from progen_tpu.serving.engine import (
+    PendingPrefill,
+    PreparedParams,
+    ServeEngine,
+    SlotBatch,
+)
+from progen_tpu.serving.prefix_cache import PrefixCache
 from progen_tpu.serving.journal import (
     RequestJournal,
     handoff_states,
@@ -40,7 +46,9 @@ from progen_tpu.serving.scheduler import (
 __all__ = [
     "ServeEngine",
     "SlotBatch",
+    "PendingPrefill",
     "PreparedParams",
+    "PrefixCache",
     "ServingMetrics",
     "Scheduler",
     "Request",

@@ -32,9 +32,10 @@ tests/test_serving.py.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import logging
-from typing import NamedTuple, Optional
+from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -84,11 +85,28 @@ class SlotBatch(NamedTuple):
     frozen: jnp.ndarray  # (S, L) bool infill frozen-position mask
 
 
-def _prefill_impl(
-    model,
-    params,
+def _feed_tokens(model, params, cache, tokens, lo, hi):
+    """Feed ``tokens[lo:hi]`` through a batch-1 cache one position at a
+    time. ``lo``/``hi`` are traced fori_loop bounds, so ONE compiled
+    program serves every (chunk size, resume depth) — the property both
+    the monolithic prefill and the budgeted chunk program below rely on
+    to keep ``prefill_compile_count`` flat. Shared verbatim by both so a
+    chunked prefill is bit-identical to the monolithic one: the loop
+    body lowers to the same HLO either way."""
+
+    def feed(p, cache):
+        tok = jax.lax.dynamic_slice(tokens, (p,), (1,))[None]
+        _, mut = model.apply(
+            {"params": params, "cache": cache}, tok, mutable=["cache"]
+        )
+        return mut["cache"]
+
+    return jax.lax.fori_loop(lo, hi, feed, cache)
+
+
+def _scatter_slot(
     slots: SlotBatch,
-    fresh_cache,
+    cache1,
     slot,
     tokens,
     start,
@@ -101,22 +119,11 @@ def _prefill_impl(
     template,
     frozen,
 ):
-    """Admit one request into ``slot``: run the prime through a FRESH
-    batch-1 cache (positions 0..start-2; a dynamic-bound fori_loop, so
-    one compile serves every prime length) and scatter the cache + all
-    per-slot state into the pool. ``slot``/``start``/``target`` are
-    traced, keeping this a single compiled program. Un-jitted body shared
-    by the bf16 and int8 entry points below."""
+    """Scatter a fully primed batch-1 cache + all per-slot state into
+    the pool and mark ``slot`` live. Pure data movement (no model
+    arithmetic), shared by the monolithic prefill and the chunked
+    finish program so activation is identical on both paths."""
     length = slots.seqs.shape[1]
-
-    def feed(p, cache):
-        tok = jax.lax.dynamic_slice(tokens, (p,), (1,))[None]
-        _, mut = model.apply(
-            {"params": params, "cache": cache}, tok, mutable=["cache"]
-        )
-        return mut["cache"]
-
-    cache1 = jax.lax.fori_loop(0, start - 1, feed, fresh_cache)
     cache = jax.tree.map(
         lambda pool, c: jax.lax.dynamic_update_index_in_dim(
             pool, c, slot, axis=0
@@ -152,6 +159,34 @@ def _prefill_impl(
     )
 
 
+def _prefill_impl(
+    model,
+    params,
+    slots: SlotBatch,
+    fresh_cache,
+    slot,
+    tokens,
+    start,
+    target,
+    key,
+    temp,
+    top_p,
+    top_k,
+    parity,
+    template,
+    frozen,
+):
+    """Admit one request into ``slot``: run the prime through a FRESH
+    batch-1 cache (positions 0..start-2; a dynamic-bound fori_loop, so
+    one compile serves every prime length) and scatter the cache + all
+    per-slot state into the pool. ``slot``/``start``/``target`` are
+    traced, keeping this a single compiled program. Un-jitted body shared
+    by the bf16 and int8 entry points below."""
+    cache1 = _feed_tokens(model, params, fresh_cache, tokens, 0, start - 1)
+    return _scatter_slot(slots, cache1, slot, tokens, start, target, key,
+                         temp, top_p, top_k, parity, template, frozen)
+
+
 @functools.partial(
     jax.jit, static_argnames=("model",), donate_argnums=(2,)
 )
@@ -182,6 +217,43 @@ def _prefill_q(model, q_params, scales, slots, fresh_cache, slot, tokens,
     return _prefill_impl(model, params, slots, fresh_cache, slot, tokens,
                          start, target, key, temp, top_p, top_k, parity,
                          template, frozen)
+
+
+@functools.partial(jax.jit, static_argnames=("model",))
+def _prefill_chunk(model, params, cache, tokens, lo, hi):
+    """One budgeted slice of a chunked prefill: feed ``tokens[lo:hi]``
+    through an in-progress batch-1 cache. ``lo``/``hi`` are TRACED, so
+    one compiled program serves every chunk size and resume depth (a
+    prefix-cache hit resumes at an arbitrary ``lo``). The cache is
+    deliberately NOT donated: the first chunk feeds the engine's
+    reusable ``fresh_cache`` zero template, and every chunk's input may
+    be a live prefix-cache snapshot — donation would invalidate both.
+    Batch-1 caches are small; the transient double-buffer is the price
+    of snapshot reuse."""
+    return _feed_tokens(model, params, cache, tokens, lo, hi)
+
+
+@functools.partial(jax.jit, static_argnames=("model",))
+def _prefill_chunk_q(model, q_params, scales, cache, tokens, lo, hi):
+    """Int8 chunk: dequantize on-device, then the shared feed loop."""
+    params = dequantize_tree(
+        q_params, scales, model.config.compute_dtype
+    )
+    return _feed_tokens(model, params, cache, tokens, lo, hi)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _prefill_finish(slots, cache1, slot, tokens, start, target, key,
+                    temp, top_p, top_k, parity, template, frozen):
+    """Final step of a chunked prefill: scatter the fully primed cache
+    + per-slot state into the pool (the ONLY point a chunked admission
+    touches the pool — mid-chunk state lives outside it, so decode
+    steps between chunks never see a half-primed slot). ``slots`` is
+    donated exactly like ``_prefill``'s pool arg; ``cache1`` is not (it
+    may be a prefix-cache snapshot). No model arithmetic, so one
+    program serves bf16 and int8 engines alike."""
+    return _scatter_slot(slots, cache1, slot, tokens, start, target, key,
+                         temp, top_p, top_k, parity, template, frozen)
 
 
 def _decode_step_impl(model, params, slots: SlotBatch):
@@ -286,6 +358,44 @@ def _match_placement(new, live):
     return new
 
 
+@dataclasses.dataclass
+class PendingPrefill:
+    """Host-side state of an in-progress chunked admission — everything
+    ``_prefill_finish`` will need, plus the batch-1 cache being fed.
+    Lives OUTSIDE the pool until the final chunk: decode steps taken
+    between chunks never observe a half-primed slot, and a crash
+    mid-chunk loses nothing durable (the journal holds the accept; a
+    replay re-runs the prefill from scratch or a prefix-cache hit).
+    ``pos`` counts prime positions already fed (the feed region is
+    ``0..start-2``; the last prime token is consumed by the first
+    decode step, exactly as in the monolithic program)."""
+
+    slot: int
+    row: jnp.ndarray  # (max_len,) int32 padded token buffer
+    start: int  # primed positions; feed region is row[0:start-1]
+    length: int  # requested total length (the slot's target)
+    key: jnp.ndarray  # per-request PRNG key (untouched until scatter)
+    temperature: float
+    top_p_val: float  # _TOP_P_OFF when off
+    top_k_val: int  # 0 when off
+    parity: bool
+    trow: jnp.ndarray  # (max_len,) int32 infill template row
+    frow: jnp.ndarray  # (max_len,) bool infill frozen row
+    cache: Any  # batch-1 cache tree fed through ``pos`` positions
+    pos: int = 0
+    hit_depth: int = 0  # prefix-cache seed depth (0 = cold)
+    request_id: str = ""
+    done: bool = False
+
+    @property
+    def feed_len(self) -> int:
+        return max(self.start - 1, 0)
+
+    @property
+    def remaining(self) -> int:
+        return self.feed_len - self.pos
+
+
 class ServeEngine:
     """Fixed-pool continuous-batching engine bound to one (model, params,
     max_slots, max_len). Host-side it is just a free-list and two jitted
@@ -331,6 +441,7 @@ class ServeEngine:
         self._free = list(range(s))
         self._targets = [l] * s  # host mirror for collect()
         self._embed_model = None  # lazily built by embed()
+        self._prefix_cache = None  # optional PrefixCache (set_prefix_cache)
         self.quantize_int8 = bool(quantize_int8)
         self.quant_report = None
         self._q_params = self._q_scales = None
@@ -442,6 +553,25 @@ class ServeEngine:
             self._q_params = prepared.q_params
             self._q_scales = prepared.q_scales
             self.quant_report = prepared.quant_report
+        if self._prefix_cache is not None:
+            # snapshots are caches computed under the OLD weights —
+            # serving one after the swap would silently answer with
+            # stale-weight activations; drop them all (counters survive,
+            # so the fleet console sees the invalidation as a bytes dip)
+            self._prefix_cache.clear()
+
+    # ----- prefix cache ---------------------------------------------------
+
+    def set_prefix_cache(self, cache) -> None:
+        """Attach a ``PrefixCache`` (serving/prefix_cache.py). Consulted
+        by ``begin_prefill`` and fed at every chunk boundary by
+        ``advance_prefill``; cleared on ``commit_params`` (snapshots are
+        weight-dependent). The engine serves fine without one."""
+        self._prefix_cache = cache
+
+    @property
+    def prefix_cache(self):
+        return self._prefix_cache
 
     # ----- slot lifecycle -------------------------------------------------
 
@@ -500,6 +630,29 @@ class ServeEngine:
         )
         _prepare_seq(self.model, prime, length, add_bos)
 
+    def _prepare_admission(self, prime, length, *, top_k, add_bos,
+                           temperature, top_p, key, seed, template,
+                           frozen):
+        """Validation + host-side row construction shared by the
+        monolithic and chunked admission paths — both must build
+        byte-identical operands or the bit-parity contract between them
+        is fiction. Returns (row, start, key, parity, trow, frow)."""
+        self.validate(prime, length, add_bos=add_bos,
+                      temperature=temperature, top_p=top_p, top_k=top_k,
+                      template=template, frozen=frozen)
+        seq, start = _prepare_seq(self.model, prime, length, add_bos)
+        row = np.zeros((self.max_len,), np.int32)
+        row[: int(seq.shape[0])] = np.asarray(seq)
+        trow = np.zeros((self.max_len,), np.int32)
+        frow = np.zeros((self.max_len,), bool)
+        if template is not None:
+            trow[:length] = np.asarray(template, np.int32).reshape(-1)
+            frow[:length] = np.asarray(frozen, bool).reshape(-1)
+        if key is None:
+            key = jax.random.PRNGKey(seed)
+        parity = temperature == 1.0 and top_p is None
+        return row, int(start), key, parity, trow, frow
+
     def prefill(self, slot: int, prime, length: int, *,
                 top_k=25, add_bos: bool = False, temperature: float = 1.0,
                 top_p=None, key=None, seed: int = 0,
@@ -512,22 +665,13 @@ class ServeEngine:
         infilling for this slot, matching ``sample_fast``'s constraint.
         ``request_id`` is telemetry-only: the prefill span carries it so
         the trace ties device work back to the request's async track."""
-        self.validate(prime, length, add_bos=add_bos,
-                      temperature=temperature, top_p=top_p, top_k=top_k,
-                      template=template, frozen=frozen)
+        row, start, key, parity, trow, frow = self._prepare_admission(
+            prime, length, top_k=top_k, add_bos=add_bos,
+            temperature=temperature, top_p=top_p, key=key, seed=seed,
+            template=template, frozen=frozen,
+        )
         with _span("serve/prefill", slot=int(slot),
                    request_id="" if request_id is None else str(request_id)):
-            seq, start = _prepare_seq(self.model, prime, length, add_bos)
-            row = np.zeros((self.max_len,), np.int32)
-            row[: int(seq.shape[0])] = np.asarray(seq)
-            trow = np.zeros((self.max_len,), np.int32)
-            frow = np.zeros((self.max_len,), bool)
-            if template is not None:
-                trow[:length] = np.asarray(template, np.int32).reshape(-1)
-                frow[:length] = np.asarray(frozen, bool).reshape(-1)
-            if key is None:
-                key = jax.random.PRNGKey(seed)
-            parity = temperature == 1.0 and top_p is None
             tail = (
                 jnp.int32(slot), jnp.asarray(row), jnp.int32(start),
                 jnp.int32(length), key,
@@ -549,6 +693,103 @@ class ServeEngine:
                 )
             self._targets[slot] = int(length)
             return int(start)
+
+    # ----- chunked admission ----------------------------------------------
+
+    def begin_prefill(self, slot: int, prime, length: int, *,
+                      top_k=25, add_bos: bool = False,
+                      temperature: float = 1.0, top_p=None, key=None,
+                      seed: int = 0, request_id: Optional[str] = None,
+                      template=None, frozen=None) -> PendingPrefill:
+        """Start a chunked admission into ``slot``: validate + build the
+        same operands as ``prefill`` but run NO device work yet — the
+        caller (the scheduler) advances the returned ``PendingPrefill``
+        with ``advance_prefill`` between decode steps. When a prefix
+        cache is attached, the longest cached prefix of the feed region
+        seeds the pending state at its depth, so a repeated scaffold
+        skips straight to the tail. The eventual token stream is
+        bit-identical to ``prefill`` with the same arguments."""
+        row, start, key, parity, trow, frow = self._prepare_admission(
+            prime, length, top_k=top_k, add_bos=add_bos,
+            temperature=temperature, top_p=top_p, key=key, seed=seed,
+            template=template, frozen=frozen,
+        )
+        pending = PendingPrefill(
+            slot=int(slot),
+            row=jnp.asarray(row),
+            start=start,
+            length=int(length),
+            key=key,
+            temperature=float(temperature),
+            top_p_val=float(_TOP_P_OFF if top_p is None else top_p),
+            top_k_val=int(0 if top_k is None else top_k),
+            parity=bool(parity),
+            trow=jnp.asarray(trow),
+            frow=jnp.asarray(frow),
+            cache=self.fresh_cache,
+            request_id="" if request_id is None else str(request_id),
+        )
+        if self._prefix_cache is not None:
+            depth, snap = self._prefix_cache.lookup(row, pending.feed_len)
+            if snap is not None:
+                pending.cache = snap
+                pending.pos = pending.hit_depth = int(depth)
+        return pending
+
+    def advance_prefill(self, pending: PendingPrefill,
+                        budget: Optional[int] = None) -> bool:
+        """Feed up to ``budget`` more prime positions (all remaining
+        when None) through the pending batch-1 cache; when the feed
+        region is exhausted, scatter + activate the slot in the same
+        call (the slot scatter happens ONLY on this final chunk).
+        Chunk boundaries are snapshotted into the prefix cache. Returns
+        True once the slot is live. ``lo``/``hi`` ride as traced
+        operands, so every chunk size reuses one compiled program."""
+        if pending.done:
+            return True
+        feed_len = pending.feed_len
+        hi = feed_len if budget is None else min(
+            feed_len, pending.pos + max(int(budget), 0)
+        )
+        with _span("serve/prefill_chunk", slot=int(pending.slot),
+                   request_id=pending.request_id,
+                   lo=int(pending.pos), hi=int(hi)):
+            if hi > pending.pos:
+                if self.quantize_int8:
+                    pending.cache = _prefill_chunk_q(
+                        self.model, self._q_params, self._q_scales,
+                        pending.cache, pending.row,
+                        jnp.int32(pending.pos), jnp.int32(hi),
+                    )
+                else:
+                    pending.cache = _prefill_chunk(
+                        self.model, self.params, pending.cache,
+                        pending.row, jnp.int32(pending.pos),
+                        jnp.int32(hi),
+                    )
+                pending.pos = int(hi)
+                if self._prefix_cache is not None:
+                    self._prefix_cache.insert(
+                        np.asarray(pending.row), pending.pos,
+                        pending.cache,
+                    )
+            if pending.pos >= feed_len:
+                tail = (
+                    jnp.int32(pending.slot), pending.row,
+                    jnp.int32(pending.start), jnp.int32(pending.length),
+                    pending.key,
+                    jnp.float32(pending.temperature),
+                    jnp.float32(pending.top_p_val),
+                    jnp.int32(pending.top_k_val),
+                    jnp.asarray(pending.parity),
+                    pending.trow, pending.frow,
+                )
+                self.slots = _prefill_finish(
+                    self.slots, pending.cache, *tail
+                )
+                self._targets[pending.slot] = int(pending.length)
+                pending.done = True
+        return pending.done
 
     # ----- the hot loop ---------------------------------------------------
 
@@ -631,4 +872,12 @@ class ServeEngine:
 
     @staticmethod
     def prefill_compile_count() -> int:
-        return _prefill._cache_size()
+        """Compiled prefill variants across the whole family: the
+        monolithic program plus the chunk and finish halves of the
+        chunked path. Flat-after-warmup is the acceptance bar for both
+        paths (traced bounds are what keep the chunk program at one)."""
+        return (
+            _prefill._cache_size()
+            + _prefill_chunk._cache_size()
+            + _prefill_finish._cache_size()
+        )

@@ -12,6 +12,19 @@ Backpressure is explicit: the queue is bounded and ``submit`` answers
 ("invalid: ..."). Invalid requests are rejected at submit time (engine
 validation, no device work) so they never occupy queue space.
 
+Chunked admission (``prefill_chunk`` > 0, or a prefix cache attached):
+instead of running the whole prime through ``engine.prefill`` inline —
+which stalls every live decode for the full prompt length — the head
+request becomes a ``PendingPrefill`` and ``step()`` feeds it at most
+``prefill_chunk`` prime positions per call before advancing the
+decoders, so a long prompt admits WHILE the pool keeps streaming. At
+most one prefill is in flight (FIFO order is preserved: later arrivals
+wait behind the head), the slot counts as occupied for the whole
+admission (the gauges and the router's least-loaded placement see it),
+and chunk progress is deliberately NOT journaled — a crash mid-chunk
+replays the accept and re-runs the prefill (or hits the prefix cache),
+which is exactly the monolithic crash contract.
+
 Every accepted request is additionally traced through the process
 telemetry as ONE async track (``{"ev": "req", "ph": "b"/"n"/"e"}``
 records, id = request): a ``request`` envelope containing the
@@ -116,6 +129,20 @@ class _Active:
     n_generated: int = 0
 
 
+@dataclasses.dataclass
+class _PendingAdmission:
+    """The head request mid-chunked-prefill: its engine-side state plus
+    the timing the scheduler owes the metrics once the slot goes live.
+    ``prefill_s`` accumulates the wall time of the chunk calls ONLY —
+    the decode steps interleaved between chunks belong to the decoders,
+    not this request's prefill_time_s."""
+
+    req: Request
+    pp: object  # engine.PendingPrefill
+    t_submit: float
+    prefill_s: float = 0.0
+
+
 class Scheduler:
     """Bounded-FIFO front of a ServeEngine. Single-threaded by design:
     the caller owns the loop and calls ``step()`` until ``has_work`` is
@@ -124,11 +151,28 @@ class Scheduler:
     def __init__(self, engine: ServeEngine, *, max_queue: int = 64,
                  metrics: Optional[ServingMetrics] = None,
                  clock: Callable[[], float] = time.perf_counter,
-                 journal=None):
+                 journal=None, prefill_chunk: int = 0,
+                 prefix_cache=None):
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        if prefill_chunk < 0:
+            raise ValueError(
+                f"prefill_chunk must be >= 0, got {prefill_chunk}"
+            )
         self.engine = engine
         self.max_queue = int(max_queue)
+        # prime positions fed per step() across pending admissions;
+        # 0 = unbudgeted (the whole prefill runs before decode resumes,
+        # the monolithic stall profile). A prefix cache alone also
+        # routes admission through the chunked path so hits can seed it.
+        self.prefill_chunk = int(prefill_chunk)
+        self.prefix_cache = prefix_cache
+        if prefix_cache is not None:
+            engine.set_prefix_cache(prefix_cache)
+        self._use_chunked = (
+            self.prefill_chunk > 0 or prefix_cache is not None
+        )
+        self._pending: Optional[_PendingAdmission] = None
         self.metrics = metrics if metrics is not None else ServingMetrics()
         # optional RequestJournal (serving/journal.py): accepted work is
         # journaled durably before submit() acknowledges it, every token
@@ -156,6 +200,7 @@ class Scheduler:
         self.metrics.set_gauge("slot_occupancy", 0)
         self.metrics.set_gauge("slots_free", self.engine.max_slots)
         self._publish_compile_gauges()
+        self._publish_prefix_gauges()
 
     def _publish_compile_gauges(self) -> None:
         self.metrics.set_gauge(
@@ -164,6 +209,20 @@ class Scheduler:
         self.metrics.set_gauge(
             "prefill_compile_count", self.engine.prefill_compile_count()
         )
+
+    def _publish_prefix_gauges(self) -> None:
+        """Prefix-cache health on the metrics registry (the raw
+        ``ev:"prefix_cache"`` records stay in prefix_cache.py —
+        PGL006): hit/miss/eviction totals plus the live byte/entry
+        footprint the byte budget bounds."""
+        if self.prefix_cache is None:
+            return
+        st = self.prefix_cache.stats()
+        self.metrics.set_gauge("prefix_cache_hits", st["hits"])
+        self.metrics.set_gauge("prefix_cache_misses", st["misses"])
+        self.metrics.set_gauge("prefix_cache_evictions", st["evictions"])
+        self.metrics.set_gauge("prefix_cache_bytes", st["bytes"])
+        self.metrics.set_gauge("prefix_cache_entries", st["entries"])
 
     # ----- request tracing ------------------------------------------------
 
@@ -186,8 +245,13 @@ class Scheduler:
         get_telemetry().emit(rec)
 
     def _emit_slots(self) -> None:
-        """Slot-occupancy counter sample, on change only."""
-        n = len(self._active)
+        """Slot-occupancy counter sample, on change only. Counts
+        ACQUIRED slots (``engine.num_active``), not decoding ones: a
+        slot mid-chunked-prefill is occupied for placement purposes —
+        the router's least-loaded scoring reads this gauge, and a slot
+        that flapped free between chunks would draw traffic to the one
+        replica that is busiest admitting."""
+        n = self.engine.num_active
         if n == self._last_slots_emitted:
             return
         self._last_slots_emitted = n
@@ -231,6 +295,14 @@ class Scheduler:
         settlement: these requests were never answered, so replay must
         pick them up."""
         now = time.time()
+        if self._pending is not None:
+            req = self._pending.req
+            self._req_event("n", req.id, reason, ts=now,
+                            trace=req.trace_id)
+            self._req_event("e", req.id, "prefill", ts=now,
+                            trace=req.trace_id)
+            self._req_event("e", req.id, "request", ts=now,
+                            trace=req.trace_id, reason=reason)
         for slot in sorted(self._active):
             req = self._active[slot].req
             self._req_event("n", req.id, reason, ts=now,
@@ -306,7 +378,11 @@ class Scheduler:
 
     @property
     def has_work(self) -> bool:
-        return bool(self._queue) or bool(self._active)
+        return (
+            bool(self._queue)
+            or bool(self._active)
+            or self._pending is not None
+        )
 
     @property
     def queue_depth(self) -> int:
@@ -393,7 +469,11 @@ class Scheduler:
         )
 
     def _admit(self) -> None:
-        while self._queue:
+        """Move queued requests onto slots. At most ONE chunked
+        admission is in flight (FIFO: later arrivals queue behind the
+        head); on the legacy inline path this loop runs whole prefills
+        until the pool or the queue is empty, exactly as before."""
+        while self._pending is None and self._queue:
             if self._queue[0][0].kind == "embed":
                 req, t_submit = self._queue.popleft()
                 self._serve_embed(req, t_submit)
@@ -407,6 +487,18 @@ class Scheduler:
                             trace=req.trace_id)
             self._req_event("b", req.id, "prefill", ts=w0,
                             trace=req.trace_id, slot=slot)
+            if self._use_chunked:
+                # no device work yet: the prime is fed chunk-at-a-time
+                # by _pump_admissions between decode steps
+                pp = self.engine.begin_prefill(
+                    slot, req.prime, req.length, top_k=req.top_k,
+                    add_bos=req.add_bos, temperature=req.temperature,
+                    top_p=req.top_p, key=req.key, seed=req.seed,
+                    request_id=req.id, template=req.template,
+                    frozen=req.frozen,
+                )
+                self._pending = _PendingAdmission(req, pp, t_submit)
+                continue  # loop condition ends admission for this step
             t0 = self._clock()
             start = self.engine.prefill(
                 slot, req.prime, req.length, top_k=req.top_k,
@@ -430,6 +522,61 @@ class Scheduler:
         self.metrics.set_gauge("active_slots", len(self._active))
         self._emit_slots()
 
+    def _activate(self, pa: _PendingAdmission) -> None:
+        """A pending prefill finished its last chunk: the slot is live
+        in the pool; open its decode phase and settle admission
+        metrics. Mirrors the inline path's bookkeeping exactly."""
+        self._pending = None
+        req, pp = pa.req, pa.pp
+        t1 = self._clock()
+        w1 = time.time()
+        self._req_event("e", req.id, "prefill", ts=w1,
+                        trace=req.trace_id)
+        self._req_event("b", req.id, "decode", ts=w1,
+                        trace=req.trace_id, slot=pp.slot)
+        self._active[pp.slot] = _Active(
+            req, pp.slot, pp.start, pa.t_submit, t1
+        )
+        self.metrics.inc("requests_admitted")
+        # only positions actually fed through the model count — a
+        # prefix-cache hit skipped the first hit_depth of them
+        self.metrics.inc(
+            "prefill_tokens", max(pp.start - 1 - pp.hit_depth, 0)
+        )
+        if pp.hit_depth > 0:
+            self.metrics.inc("prefix_cache_hit_tokens", pp.hit_depth)
+        self.metrics.add_time("prefill_time_s", pa.prefill_s)
+
+    def _pump_admissions(self) -> None:
+        """One step's admission work: start new admissions, then feed
+        at most ``prefill_chunk`` prime positions (unbounded when 0)
+        across pending prefills — the budget is per STEP, not per
+        request, so a chain of tiny primes cannot stall decode any
+        longer than one long one. A prefix-cache full hit costs zero
+        budget and activates immediately."""
+        self._admit()
+        if self._pending is None:
+            return
+        budget = self.prefill_chunk if self.prefill_chunk > 0 else None
+        spent = 0
+        while self._pending is not None:
+            allow = None
+            if budget is not None:
+                allow = budget - spent
+                if allow <= 0:
+                    break
+            pa = self._pending
+            before = pa.pp.pos
+            t0 = self._clock()
+            done = self.engine.advance_prefill(pa.pp, allow)
+            pa.prefill_s += self._clock() - t0
+            spent += pa.pp.pos - before
+            if not done:
+                break
+            self._activate(pa)
+            self._admit()
+        self._publish_prefix_gauges()
+
     def step(self) -> Tuple[List[TokenEvent], List[Completion]]:
         """Admit what fits, then advance every live slot one token.
         Returns the tokens produced this step (streaming order =
@@ -437,7 +584,7 @@ class Scheduler:
         queued requests are shed first (check ``pop_expired()``) so a
         dead deadline never consumes a freed slot."""
         self._expire_queued(self._clock())
-        self._admit()
+        self._pump_admissions()
         embed_done, self._embed_done = self._embed_done, []
         if not self._active:
             return [], embed_done

@@ -56,6 +56,16 @@ def load_trajectory(repo_root) -> list:
     return sorted(records, key=lambda r: r["round"])
 
 
+# Serving chains: dimensionless "win" ratios from the decode-admit-stall
+# phase (monolithic/chunked ITL p99, cold/hit TTFT). Higher is better,
+# exactly like the throughput chains, so the same ratchet applies. Each
+# observation is either the round's own headline (``metric`` matches) or
+# a same-named direct key any round may attach (the carry idiom
+# ``last_tpu_record`` established: a round whose headline is the train
+# number still keeps the serving record on the chain).
+SERVE_CHAINS = ("serve_admit_stall_ratio", "serve_prefix_cache_speedup")
+
+
 def _candidates(records: list, metric: str):
     """(value, round, carried) observations for one metric chain."""
     for rec in records:
@@ -67,6 +77,12 @@ def _candidates(records: list, metric: str):
         if metric == "cpu":
             if name == "cpu_fallback_smoke_tokens_per_sec" and value:
                 yield float(value), rec["round"], False
+        elif metric in SERVE_CHAINS:
+            if name == metric and value:
+                yield float(value), rec["round"], False
+            carry = p.get(metric)
+            if name != metric and isinstance(carry, (int, float)) and carry:
+                yield float(carry), rec["round"], True
         elif metric == "tpu":
             if (
                 name.startswith("train_tokens")
@@ -86,7 +102,7 @@ def best_prior(records: list, metric: str = "auto") -> Optional[dict]:
     observation — the real SLO — falling back to cpu."""
     if metric == "auto":
         return best_prior(records, "tpu") or best_prior(records, "cpu")
-    if metric not in ("cpu", "tpu"):
+    if metric not in ("cpu", "tpu") + SERVE_CHAINS:
         raise ValueError(f"unknown gate metric {metric!r}")
     best = None
     for value, rnd, carried in _candidates(records, metric):

@@ -81,6 +81,14 @@ def clean_fleet_metrics(reg):
     reg.inc("alerts_emitted")
 
 
+def clean_prefix_cache_metrics(reg):
+    # prefix-cache METRICS are fine anywhere — only raw records are
+    # restricted to serving/prefix_cache.py
+    reg.set_gauge("prefix_cache_hits", 3)
+    reg.set_gauge("prefix_cache_bytes", 1 << 20)
+    reg.inc("prefix_cache_hit_tokens", 64)
+
+
 def clean_other_ev_dict():
     # dict literals with other ev tags are not the collector's grammar
     return {"ev": "tsdb_block", "seq": 4, "level": 1}

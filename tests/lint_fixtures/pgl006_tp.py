@@ -1,4 +1,4 @@
-"""PGL006 true positives: telemetry hygiene. Expected findings: 28."""
+"""PGL006 true positives: telemetry hygiene. Expected findings: 31."""
 
 
 def unbounded_span(telemetry, name):
@@ -63,6 +63,17 @@ def bad_score_op(emit):
     # TP x2: outside workloads/ AND an op outside the
     # start/resume/batch/skip/done scoring alphabet
     emit({"ev": "score", "op": "progress", "n": 4})
+
+
+def raw_prefix_cache_record(emit):
+    # TP: prefix_cache record outside serving/prefix_cache.py
+    emit({"ev": "prefix_cache", "op": "hit", "depth": 8})
+
+
+def bad_prefix_cache_op(emit):
+    # TP x2: outside serving/prefix_cache.py AND an op outside the
+    # hit/miss/evict reuse alphabet
+    emit({"ev": "prefix_cache", "op": "refresh", "depth": 8})
 
 
 def bad_slo_state(emit):

@@ -452,6 +452,60 @@ class TestBenchGate:
         with pytest.raises(ValueError):
             best_prior([], "mfu")
 
+    def test_serve_chains_ratchet_and_stay_separate(self, tmp_path):
+        """The two serving ratios are independent gate chains: each
+        reads its own headline rounds plus the direct-key carry on
+        rounds whose headline is a train/cpu number, and neither leaks
+        into the cpu/tpu chains."""
+        from progen_tpu.utils.bench_gate import best_prior, load_trajectory
+
+        self._write(tmp_path, 2, {
+            "metric": "serve_admit_stall_ratio", "value": 1.8,
+            "prefix_cache_speedup": 6.0, "platform": "cpu",
+        })
+        self._write(tmp_path, 3, self._cpu_round(
+            27000.0,
+            serve_admit_stall_ratio=2.3,
+            serve_prefix_cache_speedup=9.0,
+        ))
+        records = load_trajectory(tmp_path)
+        best = best_prior(records, "serve_admit_stall_ratio")
+        assert best["value"] == 2.3 and best["carried"]
+        best = best_prior(records, "serve_prefix_cache_speedup")
+        assert best["value"] == 9.0 and best["round"] == 3
+        # the serving rounds never pollute the throughput chains
+        assert best_prior(records, "cpu")["value"] == 27000.0
+        assert best_prior(records, "tpu") is None
+
+    def test_gate_cli_from_json_key(self, bench, monkeypatch, tmp_path,
+                                    capsys):
+        """``--from-json-key`` reads the second gated number out of the
+        decode-admit-stall phase JSON."""
+        import json
+
+        monkeypatch.setattr(bench, "_REPO", tmp_path)
+        phase = tmp_path / "admit.json"
+        phase.write_text(json.dumps({
+            "phase": "decode-admit-stall",
+            "metric": "serve_admit_stall_ratio",
+            "value": 2.1, "prefix_cache_speedup": 7.5,
+        }))
+        assert bench.gate_main([
+            "--metric", "serve_prefix_cache_speedup",
+            "--from-json", str(phase),
+            "--from-json-key", "prefix_cache_speedup",
+        ]) == 0
+        assert bench.gate_main([
+            "--metric", "serve_admit_stall_ratio",
+            "--from-json", str(phase),
+        ]) == 0
+        assert bench.gate_main([
+            "--metric", "serve_admit_stall_ratio",
+            "--from-json", str(phase),
+            "--from-json-key", "no_such_key",
+        ]) == 2
+        capsys.readouterr()
+
     def test_gate_cli_exit_codes(self, bench, monkeypatch, tmp_path,
                                  capsys):
         self._write(tmp_path, 2, self._cpu_round(1000.0))
@@ -505,3 +559,12 @@ class TestFusedPhaseDispatch:
         assert names["kernel-fused-w256"] > 0
         assert names["kernel-fused-w512"] > 0
         assert names["decode-int8"] > 0
+        assert names["decode-admit-stall"] > 0
+
+    def test_decode_admit_stall_dispatches(self, bench, monkeypatch):
+        def fake():
+            return {"phase": "decode-admit-stall"}
+
+        monkeypatch.setattr(bench, "_decode_admit_stall_bench", fake)
+        res = bench.run_phase("decode-admit-stall")
+        assert res["phase"] == "decode-admit-stall"

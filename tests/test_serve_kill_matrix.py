@@ -66,7 +66,7 @@ def workspace(tmp_path_factory):
     }
 
 
-def _spawn(ck, journal_dir, *, chaos="", replay=False):
+def _spawn(ck, journal_dir, *, chaos="", replay=False, extra=()):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["PROGEN_CHAOS"] = chaos
@@ -77,6 +77,7 @@ def _spawn(ck, journal_dir, *, chaos="", replay=False):
         "--max-slots", "2", "--max-queue", "16", "--max-len", "24",
         "--journal_dir", str(journal_dir),
     ]
+    args += list(extra)
     if replay:
         args += ["--replay", str(journal_dir)]
     return subprocess.Popen(
@@ -126,17 +127,20 @@ def _journal_accepts(journal_dir):
     return accepts
 
 
-def _kill_then_replay(workspace, tmp_path, chaos, n_requests=4):
+def _kill_then_replay(workspace, tmp_path, chaos, n_requests=4,
+                      requests=None, extra=()):
     """Shared body: run serve under a kill rule, then a chaos-free
-    ``--replay`` run; return (tokens1, done1, tokens2, done2, accepts)."""
+    ``--replay`` run (same flags); return
+    (tokens1, done1, tokens2, done2, accepts)."""
     jd = tmp_path / "jd"
-    proc = _spawn(workspace["ck"], jd, chaos=chaos)
+    proc = _spawn(workspace["ck"], jd, chaos=chaos, extra=extra)
+    reqs = _requests(n_requests) if requests is None else requests
     out1, err1 = proc.communicate(
-        input="\n".join(_requests(n_requests)) + "\n", timeout=240
+        input="\n".join(reqs) + "\n", timeout=240
     )
     assert proc.returncode == -9, (out1[-1000:], err1[-2000:])
 
-    proc = _spawn(workspace["ck"], jd, replay=True)
+    proc = _spawn(workspace["ck"], jd, replay=True, extra=extra)
     out2, err2 = proc.communicate(input="", timeout=240)
     assert proc.returncode == 0, (out2[-1000:], err2[-2000:])
     assert "replay:" in err2
@@ -189,6 +193,38 @@ class TestDeterministicKills:
         assert tokens1, "kill@6 should land after some tokens streamed"
         # the kill landed mid-flight: someone was still decoding
         assert tokens2, "nothing resumed — kill came after all work done"
+
+    def test_kill_mid_chunk_replay_settles_once(
+        self, workspace, tmp_path
+    ):
+        """SIGKILL inside the second prefill CHUNK — the slot is
+        acquired and partially primed but never activated. The journal
+        must hold no partial-prefill state (ops stay accept/token/done
+        only), and a chaos-free ``--replay`` with the same chunked
+        flags must settle every accepted request exactly once: the
+        whole prefill simply re-runs from the accept record."""
+        reqs = [
+            json.dumps({
+                "id": f"c{i}", "prime": "MKVLATGLLSDQ", "length": 20,
+                "seed": 50 + i,
+            })
+            for i in range(4)
+        ]
+        jd_ops = []
+        _, _, _, done2, accepts = _kill_then_replay(
+            workspace, tmp_path, "serve/prefill_chunk:kill@2",
+            requests=reqs,
+            extra=["--prefill_chunk", "4", "--prefix_cache_mb", "8"],
+        )
+        assert done2, "replay settled nothing"
+        # zero partial-prefill journal records: the replay alphabet is
+        # still accept/token/done — chunk progress is never journaled
+        from progen_tpu.telemetry.trace import iter_jsonl
+
+        for rec in iter_jsonl(tmp_path / "jd" / "journal.jsonl"):
+            if rec.get("ev") == "journal":
+                jd_ops.append(rec["op"])
+        assert jd_ops and set(jd_ops) <= {"accept", "token", "done"}
 
 
 @pytest.mark.slow
@@ -358,7 +394,8 @@ class TestChaosTargets:
     def test_serving_targets_are_known(self):
         from progen_tpu.resilience import chaos
 
-        for target in ("serve/prefill", "serve/decode", "serve/reload",
+        for target in ("serve/prefill", "serve/prefill_chunk",
+                       "serve/decode", "serve/reload",
                        "serve/reload_commit"):
             assert target in chaos.KNOWN_TARGETS
         with warnings.catch_warnings():
