@@ -190,6 +190,10 @@ _PHASES = (
     # speedup — the two gated serving ratios (bench.py gate --metric
     # serve_admit_stall_ratio / serve_prefix_cache_speedup)
     ("decode-admit-stall", 600),
+    # framed-TCP loopback vs unix socket on real serve subprocesses
+    # (pinned to CPU: host-side transport parity, no chip claim) — the
+    # gated serve_transport_parity ratio
+    ("transport-overhead", 600),
     # int8 weight-quantized decode vs fp on the same params (quant
     # compile cost rides the engine build; two decode jits total)
     ("decode-int8", 600),
@@ -1592,6 +1596,246 @@ def _decode_admit_stall_bench() -> dict:
     }
 
 
+def _transport_overhead_bench() -> dict:
+    """Framed-TCP loopback vs unix-socket serving: the cost of the
+    length-prefixed frame envelope (progen_tpu/fleet/transport.py) on
+    the two client-visible numbers, TTFT and streamed tokens/s.
+
+    Two REAL ``cli/serve`` subprocesses (smoke shapes, pinned to CPU so
+    the phase never fights the suite's chip claim) serve the identical
+    request set — once over ``--socket``, once over ``--tcp`` on
+    loopback — with one warmup request paying both compiles outside
+    each measured window. Model compute is identical on both sides, so
+    the ratios isolate the transport. Headline ``value`` =
+    min(tcp/unix tokens-per-sec ratio, unix/tcp TTFT ratio) — the
+    conservative parity number, ~1.0 when framing is free, and the
+    bench gate ratchets it (``--metric serve_transport_parity``).
+    Host-side by construction: honest on any runner, which is why
+    tier1.yml can enforce it."""
+    import re as _re
+    import select
+    import signal as _signal
+    import socket
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    from flax.core import meta
+
+    from progen_tpu.checkpoint import Package, get_checkpoint_fns
+    from progen_tpu.config import ProGenConfig
+    from progen_tpu.fleet.transport import (
+        FrameDecoder,
+        encode_frame,
+        fleet_token,
+        parse_hostport,
+    )
+    from progen_tpu.models.progen import ProGen
+
+    n_requests = 8
+    gen_length = 20
+    config = ProGenConfig(
+        num_tokens=256, dim=32, seq_len=32, depth=2, window_size=8,
+        global_mlp_depth=1, heads=2, dim_head=16, ff_mult=2,
+        dtype="float32",
+    )
+
+    def _measure(transport, root, ck):
+        """One serve subprocess + one client connection; returns TTFT,
+        tokens/s, and the full (id -> [(index, token)]) streams."""
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PROGEN_CHAOS", None)
+        env["PYTHONPATH"] = f"{_REPO}{os.pathsep}" + env.get(
+            "PYTHONPATH", ""
+        )
+        spath = str(root / f"{transport}.sock")
+        args = [
+            sys.executable, "-m", "progen_tpu.cli.serve",
+            "--checkpoint_path", str(ck),
+            "--max-slots", "4", "--max-queue", "32", "--max-len", "28",
+            "--journal_dir", str(root / f"jd_{transport}"),
+        ]
+        args += (["--socket", spath] if transport == "unix"
+                 else ["--tcp", "127.0.0.1:0"])
+        err_path = root / f"{transport}.err"
+        proc = subprocess.Popen(
+            args, stdout=subprocess.DEVNULL,
+            stderr=open(err_path, "w"), env=env,
+        )
+        try:
+            # endpoint discovery: serve prints "listening on ..." once
+            # the transport is bound (the ephemeral-port handshake)
+            endpoint = None
+            deadline = time.time() + 180
+            while time.time() < deadline and endpoint is None:
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"serve died: {err_path.read_text()[-2000:]}"
+                    )
+                m = _re.search(
+                    r"listening on (?:tcp )?(\S+)",
+                    err_path.read_text(),
+                )
+                if m:
+                    endpoint = m.group(1)
+                else:
+                    time.sleep(0.2)
+            if endpoint is None:
+                raise RuntimeError(f"{transport} serve never listened")
+
+            auth = fleet_token()
+            if transport == "unix":
+                sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                sock.connect(spath)
+                dec = None
+            else:
+                host, port = parse_hostport(endpoint)
+                sock = socket.create_connection((host, port), timeout=5)
+                sock.setsockopt(
+                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                )
+                dec = FrameDecoder(auth=auth, peer="bench")
+            state = {"buf": b""}
+
+            def send_req(obj):
+                line = json.dumps(obj)
+                if dec is None:
+                    sock.sendall(line.encode() + b"\n")
+                else:
+                    sock.sendall(encode_frame(line, auth=auth))
+
+            def pump_until_done(want, timeout_s):
+                """Drain events until every id in ``want`` is done;
+                each event is stamped with its host arrival time."""
+                events, got = [], set()
+                stop = time.time() + timeout_s
+                while time.time() < stop and not want <= got:
+                    r, _, _ = select.select([sock], [], [], 0.5)
+                    if not r:
+                        continue
+                    data = sock.recv(65536)
+                    if not data:
+                        break
+                    if dec is not None:
+                        raws = dec.feed(data)
+                    else:
+                        state["buf"] += data
+                        *full, state["buf"] = state["buf"].split(b"\n")
+                        raws = [f.decode() for f in full if f.strip()]
+                    now = time.perf_counter()
+                    for raw in raws:
+                        ev = json.loads(raw)
+                        ev["_t"] = now
+                        events.append(ev)
+                        if ev.get("event") == "done":
+                            got.add(ev["id"])
+                if not want <= got:
+                    raise RuntimeError(
+                        f"{transport}: undone after {timeout_s}s: "
+                        f"{sorted(want - got)}"
+                    )
+                return events
+
+            # warmup: both compiles + cache init outside the window
+            t0 = time.perf_counter()
+            send_req({"id": "warm", "prime": "MKV", "length": 12,
+                      "seed": 1})
+            pump_until_done({"warm"}, 300)
+            compile_s = time.perf_counter() - t0
+            _mark(f"transport {transport}: warm in {compile_s:.1f}s")
+
+            submits = {}
+            for i in range(n_requests):
+                rid = f"r{i}"
+                submits[rid] = time.perf_counter()
+                send_req({"id": rid, "prime": "MKV",
+                          "length": gen_length, "seed": 70 + i})
+            events = pump_until_done(set(submits), 300)
+
+            first, streams, n_tokens = {}, {}, 0
+            for ev in events:
+                if ev.get("event") != "token":
+                    continue
+                n_tokens += 1
+                first.setdefault(ev["id"], ev["_t"])
+                streams.setdefault(ev["id"], []).append(
+                    (ev["index"], ev["token"])
+                )
+            wall = max(ev["_t"] for ev in events) - min(submits.values())
+            ttfts = [first[r] - submits[r] for r in submits]
+            sock.close()
+            return {
+                "ttft_mean_s": sum(ttfts) / len(ttfts),
+                "tokens_per_sec": n_tokens / max(wall, 1e-9),
+                "tokens": n_tokens,
+                "streams": streams,
+                "compile_s": compile_s,
+            }
+        finally:
+            if proc.poll() is None:
+                proc.send_signal(_signal.SIGTERM)  # graceful drain
+                try:
+                    proc.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait(timeout=10)
+
+    with tempfile.TemporaryDirectory() as td:
+        root = Path(td)
+        model = ProGen(config)
+        variables = model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, config.seq_len), jnp.int32),
+        )
+        params = meta.unbox(variables)["params"]
+        _, _, save = get_checkpoint_fns(str(root / "ck"))
+        save(Package(0, {"params": params}, config.to_dict(),
+                     "transport-bench"))
+        _mark(f"transport: checkpoint saved, {n_requests} reqs/side")
+
+        unix = _measure("unix", root, root / "ck")
+        tcp = _measure("tcp", root, root / "ck")
+
+    tps_ratio = tcp["tokens_per_sec"] / max(unix["tokens_per_sec"], 1e-9)
+    ttft_ratio = unix["ttft_mean_s"] / max(tcp["ttft_mean_s"], 1e-9)
+    value = min(tps_ratio, ttft_ratio)
+    _mark(f"transport: tps_ratio={tps_ratio:.3f} "
+          f"ttft_ratio={ttft_ratio:.3f}")
+    return {
+        "phase": "transport-overhead",
+        "metric": "serve_transport_parity",
+        "value": round(value, 3),
+        "host_side": True,
+        "timing_suspect": False,
+        "config": "smoke-serve32",
+        "n_requests": n_requests,
+        "tokens_per_sec_ratio": round(tps_ratio, 3),
+        "ttft_ratio": round(ttft_ratio, 3),
+        "unix_ttft_mean_s": round(unix["ttft_mean_s"], 4),
+        "tcp_ttft_mean_s": round(tcp["ttft_mean_s"], 4),
+        "unix_tokens_per_sec": round(unix["tokens_per_sec"], 1),
+        "tcp_tokens_per_sec": round(tcp["tokens_per_sec"], 1),
+        # transport must not touch the sampled streams: same seeds,
+        # same tokens, bit for bit
+        "bit_identical": tcp["streams"] == unix["streams"],
+        "compile_s": {
+            "unix": round(unix["compile_s"], 1),
+            "tcp": round(tcp["compile_s"], 1),
+        },
+        "platform": "host",
+    }
+
+
+def _transport_overhead_safe() -> dict:
+    """_transport_overhead_bench that degrades to an error record
+    instead of killing the run (it spawns serve subprocesses)."""
+    try:
+        return _transport_overhead_bench()
+    except Exception as e:
+        return {"phase": "transport-overhead", "error": repr(e)[:300]}
+
+
 def _decode_int8_bench() -> dict:
     """Int8 weight-quantized decode (ops/quant.py, --int8 on the serve
     CLI) vs the full-precision engine built from the SAME params: decode
@@ -2054,6 +2298,8 @@ def run_phase(name: str) -> dict:
         return _decode_serve_bench()
     if name == "decode-admit-stall":
         return _decode_admit_stall_bench()
+    if name == "transport-overhead":
+        return _transport_overhead_bench()
     if name == "decode-int8":
         return _decode_int8_bench()
     if name == "batch-score":
@@ -2275,9 +2521,8 @@ def main() -> None:
             continue
         with telemetry.span(f"bench/{name}", timeout=timeout):
             res = _run_phase_subprocess(name, min(timeout, remaining))
-        if "error" not in res and not _is_tpu_platform(
-            res.get("platform", "tpu")
-        ):
+        if "error" not in res and not res.get("host_side") \
+                and not _is_tpu_platform(res.get("platform", "tpu")):
             # belt-and-suspenders vs BENCH_REQUIRE_TPU: a fallback result
             # must never be recorded as TPU suite evidence
             res = {
@@ -2351,6 +2596,14 @@ def main() -> None:
             headline["serve_prefix_cache_speedup"] = res[
                 "prefix_cache_speedup"
             ]
+        elif ph == "transport-overhead":
+            summary[ph] = {
+                "parity": res["value"],
+                "bit_identical": res["bit_identical"],
+            }
+            # same carry idiom: keep the transport record on the chain
+            # even in rounds whose parsed metric is the train number
+            headline["serve_transport_parity"] = res["value"]
         elif ph == "decode-int8":
             summary[ph] = {
                 "int8_tps": res["int8_tokens_per_sec"],

@@ -73,6 +73,17 @@ dashboard query then matches nothing. Three checks:
     ``kind``/``state`` values must come from the
     ``staleness``/``slo_burn`` and
     ``stale``/``fresh``/``warn``/``burning``/``resolved`` alphabets.
+  * ``"ev": "scale"`` dict literals (autoscaler decisions) may only be
+    built in ``fleet/autoscaler.py``, must carry ``action`` and
+    ``reason`` (the CI autoscale smoke asserts an up AND a down were
+    observed, by exactly those fields), and a literal ``action`` must
+    be ``up``/``down``/``hold``.
+  * ``"ev": "frame_drop"`` dict literals (rejected transport frames)
+    may only be built in ``fleet/transport.py`` — a drop record is the
+    transport's proof a frame was condemned, and a hand-rolled one
+    would claim enforcement that never ran; a literal ``reason`` must
+    come from the ``bad_magic``/``bad_version``/``bad_auth``/
+    ``oversized``/``chaos``/``idle_timeout`` alphabet.
 """
 
 from __future__ import annotations
@@ -143,6 +154,10 @@ class TelemetryHygieneRule(Rule):
     _ALERT_KINDS = ("staleness", "slo_burn")
     _ALERT_STATES = ("stale", "fresh", "warn", "burning", "resolved")
     _SAMPLE_ROLES = ("replica", "router", "run")
+    _SCALE_FIELDS = ("action", "reason")
+    _SCALE_ACTIONS = ("up", "down", "hold")
+    _DROP_REASONS = ("bad_magic", "bad_version", "bad_auth",
+                     "oversized", "chaos", "idle_timeout")
 
     def visit_Dict(self, node: ast.Dict) -> None:
         self.generic_visit(node)
@@ -200,6 +215,53 @@ class TelemetryHygieneRule(Rule):
                     "alert record 'state'",
                     "the console colors and the smoke's quiet/burn "
                     "asserts only know these states",
+                )
+            elif v.value == "scale":
+                if not self._in_module("fleet/autoscaler.py"):
+                    self.report(
+                        v,
+                        "raw scale record built outside "
+                        "fleet/autoscaler.py — scaling decisions are the "
+                        "policy engine's judgment (hysteresis, cooldowns, "
+                        "edge-triggering), and the CI autoscale smoke "
+                        "keys on its records alone; go through "
+                        "Autoscaler.decide, not hand-rolled records",
+                    )
+                present = {
+                    kk.value for kk in node.keys if _str_const(kk)
+                }
+                missing = [
+                    f for f in self._SCALE_FIELDS if f not in present
+                ]
+                if missing:
+                    self.report(
+                        v,
+                        f"scale record missing field(s) "
+                        f"{'/'.join(missing)} — the autoscale smoke "
+                        f"asserts an up AND a down were observed by "
+                        f"exactly the action/reason fields",
+                    )
+                self._check_literal_member(
+                    node, "action", self._SCALE_ACTIONS,
+                    "scale record 'action'",
+                    "the smoke's up/down asserts and summarize only "
+                    "know these actions",
+                )
+            elif v.value == "frame_drop":
+                if not self._in_module("fleet/transport.py"):
+                    self.report(
+                        v,
+                        "raw frame_drop record built outside "
+                        "fleet/transport.py — a drop record is the "
+                        "transport's proof a frame was validated and "
+                        "condemned; a hand-rolled one claims enforcement "
+                        "that never ran",
+                    )
+                self._check_literal_member(
+                    node, "reason", self._DROP_REASONS,
+                    "frame_drop record 'reason'",
+                    "drop triage greps exactly this reason set; an "
+                    "unknown reason is an invisible wire failure",
                 )
 
     def _check_span_name(self, node: ast.Call) -> None:

@@ -18,12 +18,27 @@ handoff (serving/router.py). Two ways to get a fleet:
     journal — safe against double-serving because the handoff writes
     ``handed_off`` ownership marks BEFORE any restart can replay.
 
-Requests arrive on stdin (default) or a unix socket (--socket PATH),
-exactly as cli/serve.py: one JSON object per line, ``id`` required,
-optional ``tenant`` for per-tenant quotas. Token/done/rejected events
-stream back interleaved. Shedding reasons the router adds on top of
-the replica's: ``router_queue_full``, ``tenant_quota``, ``draining``,
+Requests arrive on stdin (default), a unix socket (--socket PATH), or
+framed TCP (--listen_tcp HOST:PORT — fleet/transport.py), exactly as
+cli/serve.py: one JSON object per line, ``id`` required, optional
+``tenant`` for per-tenant quotas. Token/done/rejected events stream
+back interleaved. Replicas may be remote too: ``--replica
+tcp=HOST:PORT,...`` dials the framed transport a ``serve --tcp``
+process listens on. Shedding reasons the router adds on top of the
+replica's: ``router_queue_full``, ``tenant_quota``, ``draining``,
 ``no_replicas``, ``replica_lost``.
+
+AUTOSCALING (fleet/autoscaler.py): ``--autoscale POLICY.toml
+--autoscale_tsdb DIR`` runs a policy tick against the fleet
+collector's ring TSDB inside the spawned-fleet loop. Scale-up revives
+the lowest retired replica slot (or grows the fleet) and spawns its
+serve process with ``--replay`` of its own journal; scale-down retires
+the highest live index — no new work, queued requests released back to
+the router (journaled ``handed_off``), SIGTERM once its slots drain
+(or on the grace deadline; the EOF rides the normal handoff path
+either way, so accepted work is never lost). Every up/down decision
+(and each hold-reason change) lands as an ``ev:"scale"`` record in the
+router's events.jsonl.
 
 SIGTERM/SIGINT drains: intake closes, queued requests are shed with
 reason ``draining``, in-flight streams (and any handoffs their
@@ -50,14 +65,16 @@ import signal
 import socket as socketlib
 import subprocess
 import sys
+import time
 
 import click
 
 
 @click.command()
 @click.option("--replica", "replica_specs", multiple=True,
-              help="replica endpoint, repeatable: "
-                   "'sock=PATH[,journal=DIR][,prom=FILE][,name=N]' or a "
+              help="replica endpoint, repeatable: 'sock=PATH' or "
+                   "'tcp=HOST:PORT', plus "
+                   "'[,journal=DIR][,prom=FILE][,name=N]', or a "
                    "bare socket path (no journal = no handoff, only "
                    "re-dispatch of never-accepted requests)")
 @click.option("--spawn", default=0,
@@ -90,6 +107,16 @@ import click
 @click.option("--socket", "socket_path", default=None, type=str,
               help="serve a unix domain socket at PATH instead of "
                    "stdin/stdout")
+@click.option("--listen_tcp", default=None, type=str,
+              help="serve framed TCP at HOST:PORT (fleet transport; "
+                   "PORT 0 = ephemeral, bound port printed on stderr)")
+@click.option("--autoscale", "autoscale_policy", default=None, type=str,
+              help="autoscale the --spawn fleet from the [autoscaler] "
+                   "table of this TOML policy file (fleet/autoscaler.py)")
+@click.option("--autoscale_tsdb", default=None, type=str,
+              help="the fleet collector's ring-TSDB directory the "
+                   "autoscaler reads its signals from (required with "
+                   "--autoscale)")
 @click.option("--metrics-every", default=0,
               help="log a router/ metrics snapshot (and rewrite "
                    "--prom_file) every N loop ticks (0 = only at exit)")
@@ -100,10 +127,11 @@ import click
                    "localhost port (0 = off)")
 def main(replica_specs, spawn, checkpoint_path, fleet_dir, respawn,
          replica_max_slots, replica_max_queue, max_len, max_queue,
-         tenant_quota, heartbeat_timeout, socket_path, metrics_every,
+         tenant_quota, heartbeat_timeout, socket_path, listen_tcp,
+         autoscale_policy, autoscale_tsdb, metrics_every,
          prom_file, prom_port):
     from progen_tpu import telemetry
-    from progen_tpu.resilience.chaos import install_from_env
+    from progen_tpu.resilience.chaos import ChaosError, install_from_env
     from progen_tpu.serving.router import Router, parse_replica_spec
     from progen_tpu.telemetry import (
         prometheus_text,
@@ -120,6 +148,12 @@ def main(replica_specs, spawn, checkpoint_path, fleet_dir, respawn,
         sys.exit("use --spawn or --replica, not both")
     if not spawn and not replica_specs:
         sys.exit("no fleet: pass --replica specs or --spawn N")
+    if autoscale_policy and not spawn:
+        sys.exit("--autoscale needs --spawn (the router must own the "
+                 "replica processes it scales)")
+    if autoscale_policy and not autoscale_tsdb:
+        sys.exit("--autoscale needs --autoscale_tsdb DIR (the fleet "
+                 "collector's TSDB is the policy's signal source)")
 
     procs = {}  # replica index -> (Popen, replica_dir, log file)
 
@@ -151,15 +185,18 @@ def main(replica_specs, spawn, checkpoint_path, fleet_dir, respawn,
             file=sys.stderr,
         )
 
+    def _spawned_spec(i):
+        rdir = os.path.join(fleet_dir, f"replica{i}")
+        return parse_replica_spec(
+            f"sock={os.path.join(rdir, 'serve.sock')},"
+            f"journal={rdir},"
+            f"prom={os.path.join(rdir, 'metrics.prom')}"
+        )
+
     if spawn:
         specs = []
         for i in range(spawn):
-            rdir = os.path.join(fleet_dir, f"replica{i}")
-            specs.append(parse_replica_spec(
-                f"sock={os.path.join(rdir, 'serve.sock')},"
-                f"journal={rdir},"
-                f"prom={os.path.join(rdir, 'metrics.prom')}"
-            ))
+            specs.append(_spawned_spec(i))
             _spawn_replica(i)
     else:
         specs = [parse_replica_spec(s) for s in replica_specs]
@@ -201,9 +238,103 @@ def main(replica_specs, spawn, checkpoint_path, fleet_dir, respawn,
         )
     print(
         f"routing across {len(specs)} replica(s): "
-        + ", ".join(s.socket_path for s in specs),
+        + ", ".join(s.endpoint for s in specs),
         file=sys.stderr,
     )
+
+    # ----- autoscaler executor (fleet/autoscaler.py decides, this
+    # closure acts on the spawned fleet) -------------------------------
+    autoscale_fn = None
+    scale_state = {"next": 0.0, "draining": {}}  # index -> grace deadline
+    if autoscale_policy:
+        from progen_tpu.fleet.autoscaler import (
+            ACTION_DOWN,
+            ACTION_UP,
+            Autoscaler,
+            load_policy,
+        )
+        from progen_tpu.telemetry.tsdb import TsdbReader
+
+        policy = load_policy(autoscale_policy)
+        scaler = Autoscaler(policy, reader=TsdbReader(autoscale_tsdb))
+        router.rebalance_max = policy.rebalance_max
+        # a retiring replica gets this long to finish its decode slots
+        # before SIGTERM stops waiting (SIGTERM itself is still a
+        # graceful drain on the serve side)
+        drain_grace_s = max(10.0, policy.interval_s * 5)
+        print(
+            f"autoscaler: {policy.min_replicas}..{policy.max_replicas} "
+            f"replicas, tick {policy.interval_s}s, tsdb {autoscale_tsdb}",
+            file=sys.stderr,
+        )
+
+        def _scale_up(n):
+            for _ in range(n):
+                reusable = sorted(
+                    link.index for link in router.links
+                    if link.retired and link.index not in procs
+                    and link.index not in scale_state["draining"]
+                )
+                if reusable:
+                    i = reusable[0]
+                    router.revive_replica(i)
+                else:
+                    i = router.add_replica(_spawned_spec(len(router.links)))
+                # --replay unconditionally: a no-op on a fresh journal,
+                # and on a reused slot it resumes whatever the handoff
+                # didn't settle (the handed_off ownership marks make
+                # double-serving impossible)
+                _spawn_replica(i, replay=True)
+
+        def _scale_down(n, now):
+            live = sorted(
+                (link.index for link in router.links if not link.retired),
+                reverse=True,
+            )
+            for i in live[:n]:
+                router.retire_replica(i)
+                scale_state["draining"][i] = now + drain_grace_s
+                print(f"replica{i}: retiring (scale-down)",
+                      file=sys.stderr)
+
+        def _reap_draining(now):
+            for i, deadline in list(scale_state["draining"].items()):
+                entry = procs.get(i)
+                if entry is None:
+                    # already exited; tick() reaped the process
+                    scale_state["draining"].pop(i)
+                    continue
+                if router.links[i].inflight and now < deadline:
+                    continue  # still streaming: let it finish
+                # SIGTERM = serve's graceful drain (in-flight slots run
+                # to completion, journal/metrics flush, exit 0). What it
+                # rejects as 'draining' the router re-routes; if it dies
+                # instead, the EOF rides the normal handoff path. Zero
+                # accepted requests lost either way.
+                entry[0].terminate()
+                scale_state["draining"].pop(i)
+
+        def _autoscale_tick():
+            now = time.monotonic()
+            _reap_draining(now)
+            if now < scale_state["next"]:
+                return
+            scale_state["next"] = now + policy.interval_s
+            n_current = sum(
+                1 for link in router.links if not link.retired
+            )
+            try:
+                decision = scaler.decide(n_current)
+            except ChaosError:
+                # autoscaler/decide chaos: a transient fault costs one
+                # tick, never the fleet
+                return
+            if decision.action == ACTION_UP:
+                _scale_up(decision.target - n_current)
+            elif decision.action == ACTION_DOWN:
+                _scale_down(n_current - decision.target, now)
+
+        autoscale_fn = _autoscale_tick
 
     shutdown = {"flag": False}
 
@@ -239,13 +370,21 @@ def main(replica_specs, spawn, checkpoint_path, fleet_dir, respawn,
                 f"replica{i}: exited rc={proc.returncode}",
                 file=sys.stderr,
             )
-            if respawn and not router.links[i].up:
+            # a retired replica's exit is the scale-down completing,
+            # not a death to heal
+            if respawn and not router.links[i].up \
+                    and not router.links[i].retired:
                 _spawn_replica(i, replay=True)
+        if autoscale_fn is not None:
+            autoscale_fn()
 
     old_term = signal.signal(signal.SIGTERM, _request_drain)
     old_int = signal.signal(signal.SIGINT, _request_drain)
     try:
-        if socket_path:
+        if listen_tcp:
+            _front_tcp(router, listen_tcp, publish, metrics_every,
+                       shutdown, tick=tick)
+        elif socket_path:
             _front_socket(router, socket_path, publish, metrics_every,
                           shutdown, tick=tick)
         else:
@@ -441,6 +580,93 @@ def _front_socket(router, socket_path, publish, metrics_every, shutdown,
         srv.close()
         if os.path.exists(socket_path):
             os.unlink(socket_path)
+
+
+def _front_tcp(router, hostport, publish, metrics_every, shutdown,
+               tick=None):
+    """Framed-TCP front (fleet/transport.py): the unix-socket front
+    with frames instead of newlines. Each connection submits requests
+    and receives exactly its own events; a framing violation reads as
+    EOF and drops only that client."""
+    from progen_tpu.fleet.transport import FramedListener, parse_hostport
+
+    host, port = parse_hostport(hostport)
+    listener = FramedListener(host, port)
+    clients = {}  # fd -> FramedConnection
+    ticks = 0
+    drained = False
+    print(f"listening on tcp {listener.host}:{listener.port}",
+          file=sys.stderr)
+    sys.stderr.flush()
+
+    def send(fd, ev):
+        conn = clients.get(fd)
+        if conn is None:
+            return
+        try:
+            conn.send_line(json.dumps(ev))
+        except OSError:
+            _drop(fd)
+
+    def _drop(fd):
+        conn = clients.pop(fd, None)
+        if conn is not None:
+            conn.close()
+
+    try:
+        while True:
+            if shutdown["flag"] and not drained:
+                drained = True
+                listener.close()  # refuse new dials during drain
+                router.drain()
+            if shutdown["flag"] and not router.has_work:
+                break
+            rlist = ([] if drained else [listener])
+            rlist += list(clients.values())
+            rlist += router.fds()
+            timeout = 0.05 if router.has_work else 0.2
+            try:
+                ready, _, _ = (
+                    select.select(rlist, [], [], timeout)
+                    if rlist else ([], [], [])
+                )
+            except OSError:
+                continue  # a peer vanished between list and select
+            replica_socks = set(router.fds())
+            for obj in ready:
+                if obj is listener:
+                    conn = listener.accept()
+                    if conn is not None:
+                        clients[conn.fileno()] = conn
+                    continue
+                if obj in replica_socks:
+                    continue  # router.poll() below reads these
+                if getattr(obj, "sock", None) is None:
+                    continue  # dropped earlier this iteration
+                fd = obj.fileno()
+                if fd not in clients:
+                    continue
+                lines, eof = obj.recv_lines()
+                for line in lines:
+                    if not line.strip():
+                        continue
+                    rej = _submit_obj(router, line, client=fd)
+                    if rej is not None:
+                        send(fd, rej)
+                if eof:
+                    _drop(fd)
+            for client, ev in router.poll():
+                if client is not None:
+                    send(client, ev)
+            if tick is not None:
+                tick()
+            ticks += 1
+            if metrics_every and ticks % metrics_every == 0:
+                publish(ticks)
+    finally:
+        for fd in list(clients):
+            _drop(fd)
+        listener.close()
 
 
 if __name__ == "__main__":

@@ -36,11 +36,21 @@ event, interleaved across requests as the engine produces them:
      "latency_s": 0.02}
     {"event": "rejected", "id": "r9", "reason": "queue_full"}
 
-Two transports, same protocol:
+Three transports, same protocol:
   * default: requests on stdin, events on stdout (pipe-friendly;
     EOF drains the queue and exits);
   * --socket PATH: a unix domain socket server; each connection
-    submits requests and receives exactly its own events.
+    submits requests and receives exactly its own events;
+  * --tcp HOST:PORT: the same server over framed TCP
+    (fleet/transport.py — every frame's payload is exactly one of
+    these JSONL lines, so streams are bit-identical to the unix
+    transport and journal/replay/handoff work unchanged).
+
+Connection-oriented transports also answer a control line,
+``{"ctl": "release", "id": ...}`` — the router's rebalance/scale-down
+path asking this replica to surrender one still-queued request
+(``{"event": "released", "released": true|false}``; a granted release
+is journaled ``done(handed_off)`` so --replay skips it).
 
 Zero-downtime ops (see README "Zero-downtime ops"):
   * SIGHUP hot-reloads the newest verified checkpoint in a background
@@ -279,6 +289,15 @@ def _build(checkpoint_path, max_slots, max_len, max_queue,
 @click.option("--socket", "socket_path", default=None, type=str,
               help="serve a unix domain socket at PATH instead of "
                    "stdin/stdout")
+@click.option("--tcp", "tcp_hostport", default=None, type=str,
+              help="serve framed TCP at HOST:PORT (fleet transport: "
+                   "length-prefixed frames whose payloads are exactly "
+                   "the JSONL protocol lines; PORT 0 = ephemeral, the "
+                   "bound port is printed on stderr)")
+@click.option("--idle_timeout", default=0.0, type=float,
+              help="drop a --tcp peer silent for more than N seconds "
+                   "(0 = never; unix sockets never need this, half-open "
+                   "TCP peers hold sockets forever)")
 @click.option("--metrics-every", default=0,
               help="log a serve/ metrics snapshot to the tracker (and "
                    "rewrite --prom_file) every N decode steps "
@@ -311,8 +330,9 @@ def _build(checkpoint_path, max_slots, max_len, max_queue,
                    "(0 = off; SIGHUP always triggers a reload)")
 def main(checkpoint_path, max_slots, max_queue, max_len, quantize_int8,
          prefill_chunk, prefix_cache_mb, top_k, temperature, top_p, seed,
-         socket_path, metrics_every, prom_file, prom_port, heartbeat,
-         journal_dir, replay_dir, reload_watch):
+         socket_path, tcp_hostport, idle_timeout, metrics_every,
+         prom_file, prom_port, heartbeat, journal_dir, replay_dir,
+         reload_watch):
     from progen_tpu import telemetry
     from progen_tpu.resilience.chaos import install_from_env
     from progen_tpu.telemetry import (
@@ -488,7 +508,11 @@ def main(checkpoint_path, max_slots, max_queue, max_len, quantize_int8,
     old_int = signal.signal(signal.SIGINT, _request_drain)
     old_hup = signal.signal(signal.SIGHUP, _request_reload)
     try:
-        if socket_path:
+        if tcp_hostport:
+            _serve_tcp(sched, defaults, tcp_hostport, publish,
+                       metrics_every, shutdown, tick=tick,
+                       idle_timeout=idle_timeout)
+        elif socket_path:
             _serve_socket(sched, defaults, socket_path, publish,
                           metrics_every, shutdown, tick=tick)
         else:
@@ -625,6 +649,45 @@ def _serve_stdio(sched, defaults, publish, metrics_every, shutdown,
         emit([ln for _, ln in _shed_lines(sched, starts)])
 
 
+def _handle_client_line(sched, line, defaults, fd, owners, starts, send):
+    """One client line on a connection-oriented transport: a release
+    ctl (the router's rebalance/scale-down path asking this replica to
+    surrender a queued request) or a request submission. Request ids
+    are namespaced per connection so two clients may both call their
+    request "1"."""
+    try:
+        ctl = json.loads(line)
+    except ValueError:
+        ctl = None
+    if isinstance(ctl, dict) and ctl.get("ctl") == "release":
+        public = str(ctl.get("id"))
+        internal = f"{fd}:{public}"
+        released = sched.release(internal)
+        if released:
+            owners.pop(internal, None)
+            starts.pop(internal, None)
+        send(fd, [json.dumps({
+            "event": "released", "id": public, "released": released,
+        })])
+        return
+    req, err = _parse_request(line, defaults)
+    if req is not None and err is None:
+        public = req.id
+        req.id = f"{fd}:{public}"
+        ok, reason = sched.submit(req)
+        if ok:
+            owners[req.id] = (fd, public)
+            starts[req.id] = len(req.prime) + (1 if req.add_bos else 0)
+            return
+        err = reason
+        public_id = public
+    else:
+        public_id = req.id if req is not None else None
+    send(fd, [json.dumps({
+        "event": "rejected", "id": public_id, "reason": err,
+    })])
+
+
 def _serve_socket(sched, defaults, socket_path, publish, metrics_every,
                   shutdown, tick=None):
     """Unix-socket transport: one select loop over {listener, clients,
@@ -705,27 +768,10 @@ def _serve_socket(sched, defaults, socket_path, publish, metrics_every,
                 for raw in lines:
                     if not raw.strip():
                         continue
-                    line = raw.decode("utf-8", "replace")
-                    req, err = _parse_request(line, defaults)
-                    if req is not None and err is None:
-                        # namespace the id so clients can't collide
-                        public = req.id
-                        req.id = f"{fd}:{public}"
-                        ok, reason = sched.submit(req)
-                        if ok:
-                            owners[req.id] = (fd, public)
-                            starts[req.id] = (
-                                len(req.prime) + (1 if req.add_bos else 0)
-                            )
-                            continue
-                        err = reason
-                        public_id = public
-                    else:
-                        public_id = req.id if req is not None else None
-                    send(fd, [json.dumps({
-                        "event": "rejected", "id": public_id,
-                        "reason": err,
-                    })])
+                    _handle_client_line(
+                        sched, raw.decode("utf-8", "replace"), defaults,
+                        fd, owners, starts, send,
+                    )
             if sched.has_work:
                 events, comps = sched.step()
                 for fd, ln in _shed_lines(sched, starts, owners):
@@ -752,6 +798,111 @@ def _serve_socket(sched, defaults, socket_path, publish, metrics_every,
         srv.close()
         if os.path.exists(socket_path):
             os.unlink(socket_path)
+
+
+def _serve_tcp(sched, defaults, hostport, publish, metrics_every,
+               shutdown, tick=None, idle_timeout=0.0):
+    """Framed-TCP transport: the unix-socket loop with frames instead
+    of newlines (fleet/transport.py owns validation, drop records and
+    condemnation — a framing violation reads as EOF here). Same id
+    namespacing, same drain contract; additionally reaps peers silent
+    past ``idle_timeout``."""
+    from progen_tpu.fleet.transport import FramedListener, parse_hostport
+
+    host, port = parse_hostport(hostport)
+    listener = FramedListener(host, port, idle_timeout=idle_timeout)
+    clients = {}  # fd -> FramedConnection
+    owners = {}  # internal request id -> (fd, public id)
+    starts = {}
+    steps = 0
+    # the bound port line is the startup handshake: with PORT 0 it is
+    # the only place the ephemeral port exists
+    print(f"listening on tcp {listener.host}:{listener.port}",
+          file=sys.stderr)
+    sys.stderr.flush()
+
+    def send(fd, internal_lines):
+        conn = clients.get(fd)
+        if conn is None:
+            return
+        try:
+            for ln in internal_lines:
+                conn.send_line(ln)
+        except OSError:
+            _drop(fd)
+
+    def _drop(fd):
+        conn = clients.pop(fd, None)
+        if conn is not None:
+            conn.close()
+
+    drained = False
+    try:
+        while True:
+            if tick is not None:
+                tick()
+            for fd, conn in list(clients.items()):
+                if conn.idle_expired():
+                    _drop(fd)
+            if shutdown["flag"]:
+                if not drained:
+                    drained = True
+                    listener.close()  # refuse new dials during drain
+                    sched.drain_queue()
+                    for fd, ln in _shed_lines(sched, starts, owners):
+                        send(fd, [ln])
+                if not sched.has_work:
+                    break
+            rlist = ([] if drained else [listener]) + list(clients.values())
+            timeout = 0.0 if sched.has_work else 0.2
+            try:
+                ready, _, _ = (
+                    select.select(rlist, [], [], timeout)
+                    if rlist else ([], [], [])
+                )
+            except OSError:
+                continue  # a peer vanished between list and select
+            for obj in ready:
+                if obj is listener:
+                    conn = listener.accept()
+                    if conn is not None:
+                        clients[conn.fileno()] = conn
+                    continue
+                if obj.sock is None:
+                    continue  # dropped earlier this iteration
+                fd = obj.fileno()
+                lines, eof = obj.recv_lines()
+                for line in lines:
+                    if not line.strip():
+                        continue
+                    _handle_client_line(sched, line, defaults, fd,
+                                        owners, starts, send)
+                if eof:
+                    _drop(fd)
+            if sched.has_work:
+                events, comps = sched.step()
+                for fd, ln in _shed_lines(sched, starts, owners):
+                    send(fd, [ln])
+                for ev in events:
+                    fd, public = owners.get(ev.request_id, (None, None))
+                    if fd is None:
+                        continue
+                    ev.request_id = public
+                    send(fd, _events_to_lines([ev], [], starts))
+                for c in comps:
+                    fd, public = owners.pop(c.request_id, (None, None))
+                    if fd is None:
+                        continue
+                    start = starts.pop(c.request_id, 0)
+                    c.request_id = public
+                    send(fd, _events_to_lines([], [c], {public: start}))
+                steps += 1
+                if metrics_every and steps % metrics_every == 0:
+                    publish(steps)
+    finally:
+        for fd in list(clients):
+            _drop(fd)
+        listener.close()
 
 
 if __name__ == "__main__":

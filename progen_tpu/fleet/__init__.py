@@ -1,0 +1,62 @@
+"""Fleet layer: network transport + control plane for the serving fleet.
+
+The serving stack below this package speaks newline-JSONL over stdin
+or unix sockets, which pins the router, its replicas, and every client
+to one machine. This package is the internet-scale leg:
+
+  * ``transport.py`` — a length-prefixed binary framing layer (magic +
+    version + auth-token envelope + JSON payload) served over TCP by
+    ``progen-tpu-serve --tcp`` and ``progen-tpu-router --listen_tcp``,
+    and dialed by ``--replica tcp=HOST:PORT`` specs. The payload of
+    every frame is exactly the JSONL line the unix-socket path carries,
+    so streams are bit-identical across the two wires and journal /
+    replay / handoff work unchanged over TCP.
+  * ``autoscaler.py`` — a policy engine over the fleet collector's
+    ring TSDB: queue depth, slot occupancy and latency quantiles from
+    the merged fleet series drive scale-up/scale-down decisions with
+    hysteresis, cooldowns and min/max bounds, executed against the
+    router's ``--spawn``/``--fleet_dir`` self-managed fleet.
+
+Deliberately jax-free: framing and scaling policy are host-side
+concerns, testable and startable without a backend.
+"""
+
+from progen_tpu.fleet.autoscaler import (
+    ACTION_DOWN,
+    ACTION_HOLD,
+    ACTION_UP,
+    Autoscaler,
+    Decision,
+    ScalingPolicy,
+    load_policy,
+)
+from progen_tpu.fleet.transport import (
+    DEFAULT_MAX_FRAME,
+    FrameDecoder,
+    FrameError,
+    FramedConnection,
+    FramedListener,
+    connect_tcp,
+    encode_frame,
+    fleet_token,
+    parse_hostport,
+)
+
+__all__ = [
+    "ACTION_DOWN",
+    "ACTION_HOLD",
+    "ACTION_UP",
+    "Autoscaler",
+    "Decision",
+    "ScalingPolicy",
+    "load_policy",
+    "DEFAULT_MAX_FRAME",
+    "FrameDecoder",
+    "FrameError",
+    "FramedConnection",
+    "FramedListener",
+    "connect_tcp",
+    "encode_frame",
+    "fleet_token",
+    "parse_hostport",
+]

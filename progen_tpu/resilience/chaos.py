@@ -64,6 +64,23 @@ Router targets (the fleet kill-matrix, tests/test_router_kill_matrix):
                            lose the dead replica's in-flight work —
                            the fold is idempotent and is retried).
 
+Fleet targets (progen_tpu/fleet/ — TCP transport and autoscaler):
+
+  * ``transport/accept``  — the framed TCP listener's accept path: the
+                            dial is accepted then immediately dropped
+                            (a flaky fronting LB); the client retries
+                            or its breaker backs off;
+  * ``transport/frame``   — per decoded frame: the frame is dropped
+                            (``ev:"frame_drop"`` reason ``chaos``) and
+                            the connection condemned, simulating a
+                            corrupted/truncated frame on the wire —
+                            the router must treat the link as down and
+                            run the journal-ownership handoff;
+  * ``autoscaler/decide`` — top of each autoscaler decide tick; a
+                            transient fault must cost one tick, never
+                            the fleet (the router CLI skips the tick),
+                            and ``kill@N`` dies inside the decision.
+
 An unknown target (typo'd span name, renamed site) warns ONCE at
 install instead of silently never firing — a chaos rehearsal whose
 faults never land proves nothing.
@@ -97,7 +114,8 @@ KNOWN_TARGETS = frozenset({
     # perturb sites
     "train/loss",
     # direct maybe_inject sites
-    "router/connect", "router/dispatch", "serve/decode",
+    "autoscaler/decide", "router/connect", "router/dispatch",
+    "serve/decode", "transport/accept", "transport/frame",
 })
 
 _WARNED_UNKNOWN: set = set()

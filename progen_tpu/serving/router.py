@@ -17,6 +17,19 @@ seeded jitter, saturating at `max_delay_s` so a dead replica keeps
 being re-probed forever (elasticity: a replica that comes back simply
 gets dialed again).
 
+Replicas may live on other machines: a `tcp=HOST:PORT` spec dials the
+framed-TCP transport (fleet/transport.py) instead of a unix socket.
+The frame payload is exactly the JSONL line, so journals, replay and
+handoff are wire-agnostic, and a framing violation (condemned stream)
+is handled as a replica death. The fleet also scales at runtime:
+`add_replica`/`retire_replica`/`revive_replica` grow and shrink the
+link set (driven by fleet/autoscaler.py through cli/router.py), and
+when a link turns HEALTHY the router proactively REBALANCES — it asks
+the most-loaded donor to `{"ctl": "release"}` a bounded number of its
+still-queued requests (journaled `done(handed_off)` on the donor, so
+replay can never double-serve) and re-routes them, landing them on the
+new replica via the same least-loaded pick as everything else.
+
 Load shedding is explicit, like the scheduler's: `router_queue_full`
 when the router's own pending queue is at bound, `tenant_quota` when a
 tenant's outstanding requests hit `--tenant_quota`, `draining` after
@@ -97,19 +110,33 @@ REJECT_REPLICA_LOST = "replica_lost"
 
 @dataclasses.dataclass
 class ReplicaSpec:
-    """One replica endpoint. ``journal_dir`` is what makes handoff
-    possible — without it a dead replica's mid-stream requests can only
-    be shed (the tokens the client saw cannot be re-derived)."""
+    """One replica endpoint — a unix ``socket_path`` or a framed-TCP
+    ``tcp`` (``HOST:PORT``, fleet/transport.py), exactly one of the
+    two. ``journal_dir`` is what makes handoff possible — without it a
+    dead replica's mid-stream requests can only be shed (the tokens
+    the client saw cannot be re-derived)."""
 
-    socket_path: str
+    socket_path: Optional[str] = None
     journal_dir: Optional[str] = None
     prom_file: Optional[str] = None
     name: Optional[str] = None
+    tcp: Optional[str] = None
+
+    def __post_init__(self):
+        if bool(self.socket_path) == bool(self.tcp):
+            raise ValueError(
+                "replica spec needs exactly one of sock=PATH / "
+                "tcp=HOST:PORT"
+            )
+
+    @property
+    def endpoint(self) -> str:
+        return self.socket_path or f"tcp={self.tcp}"
 
 
 def parse_replica_spec(text: str) -> ReplicaSpec:
-    """CLI form: ``sock=PATH[,journal=DIR][,prom=FILE][,name=N]``, or a
-    bare socket path."""
+    """CLI form: ``sock=PATH`` or ``tcp=HOST:PORT``, then optional
+    ``[,journal=DIR][,prom=FILE][,name=N]`` — or a bare socket path."""
     if "=" not in text:
         return ReplicaSpec(socket_path=text)
     kw: Dict[str, str] = {}
@@ -119,14 +146,17 @@ def parse_replica_spec(text: str) -> ReplicaSpec:
             continue
         k, _, v = part.partition("=")
         kw[k.strip()] = v.strip()
-    if "sock" not in kw:
-        raise ValueError(f"--replica spec needs sock=PATH: {text!r}")
-    extra = set(kw) - {"sock", "journal", "prom", "name"}
+    if "sock" not in kw and "tcp" not in kw:
+        raise ValueError(
+            f"--replica spec needs sock=PATH or tcp=HOST:PORT: {text!r}"
+        )
+    extra = set(kw) - {"sock", "tcp", "journal", "prom", "name"}
     if extra:
         raise ValueError(f"unknown --replica key(s) {sorted(extra)}")
     return ReplicaSpec(
-        socket_path=kw["sock"], journal_dir=kw.get("journal"),
+        socket_path=kw.get("sock"), journal_dir=kw.get("journal"),
         prom_file=kw.get("prom"), name=kw.get("name"),
+        tcp=kw.get("tcp"),
     )
 
 
@@ -187,6 +217,7 @@ class _InFlight:
     text: str = ""
     first_token_t: Optional[float] = None
     hop: int = 0  # dispatch attempts that reached a replica (span id)
+    releasing: bool = False  # a rebalance release ctl is outstanding
 
 
 class ReplicaLink:
@@ -201,10 +232,15 @@ class ReplicaLink:
         self.breaker = CircuitBreaker(self.name, policy, clock)
         self.sock: Optional[socket.socket] = None
         self.buf = b""
+        self._decoder = None  # fleet.transport.FrameDecoder on tcp links
         self.inflight: Dict[str, _InFlight] = {}
         self.health: Dict[str, float] = {}
         self.health_mtime: Optional[float] = None
         self.health_rx: Optional[float] = None
+        # scale-down state: a retired link takes no new work and is
+        # never re-dialed; it stays in Router.links so indices (and the
+        # journals keyed on them) remain stable across scale cycles
+        self.retired = False
 
     @property
     def up(self) -> bool:
@@ -217,6 +253,18 @@ class ReplicaLink:
 
     def connect(self) -> None:
         maybe_inject("router/connect")
+        if self.spec.tcp is not None:
+            from progen_tpu.fleet.transport import (
+                FrameDecoder, connect_tcp, fleet_token, parse_hostport,
+            )
+
+            host, port = parse_hostport(self.spec.tcp)
+            self.sock = connect_tcp(host, port)
+            self._decoder = FrameDecoder(
+                auth=fleet_token(), peer=self.spec.tcp
+            )
+            self.buf = b""
+            return
         s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         s.settimeout(2.0)
         try:
@@ -236,10 +284,19 @@ class ReplicaLink:
                 pass
         self.sock = None
         self.buf = b""
+        self._decoder = None
 
     def send(self, obj: dict) -> None:
         assert self.sock is not None
-        data = (json.dumps(obj) + "\n").encode()
+        line = json.dumps(obj)
+        if self._decoder is not None:
+            from progen_tpu.fleet.transport import encode_frame, fleet_token
+
+            # the frame payload is exactly the JSONL line (the frame
+            # boundary replaces the newline): bit-identical wires
+            data = encode_frame(line, auth=fleet_token())
+        else:
+            data = (line + "\n").encode()
         # request lines are small; a bounded blocking send keeps the
         # loop simple (a replica that can't drain 4KB in 5s is down)
         self.sock.settimeout(5.0)
@@ -252,10 +309,14 @@ class ReplicaLink:
     def recv_events(self) -> Tuple[List[dict], bool]:
         """Drain whatever the replica has written: (events, eof). A
         SIGKILLed replica's socket reads EOF — the immediate down
-        signal the handoff rides on."""
+        signal the handoff rides on. A framing violation on a tcp link
+        (FrameError: the decoder condemned the stream) reads as EOF
+        too: a corrupted wire gets the same journal-ownership handoff a
+        dead replica does."""
         if self.sock is None:
             return [], False
         eof = False
+        chunks: List[bytes] = []
         while True:
             try:
                 data = self.sock.recv(65536)
@@ -266,14 +327,26 @@ class ReplicaLink:
             if not data:
                 eof = True
                 break
-            self.buf += data
-        *lines, self.buf = self.buf.split(b"\n")
+            chunks.append(data)
+        raws: List[str] = []
+        if self._decoder is not None:
+            if chunks:
+                from progen_tpu.fleet.transport import FrameError
+
+                try:
+                    raws = self._decoder.feed(b"".join(chunks))
+                except FrameError:
+                    eof = True
+        else:
+            self.buf += b"".join(chunks)
+            *lines, self.buf = self.buf.split(b"\n")
+            raws = [ln.decode("utf-8", "replace") for ln in lines]
         events = []
-        for raw in lines:
+        for raw in raws:
             if not raw.strip():
                 continue
             try:
-                events.append(json.loads(raw.decode("utf-8", "replace")))
+                events.append(json.loads(raw))
             except ValueError:
                 continue  # a dying writer may tear its final line
         return events, eof
@@ -292,7 +365,8 @@ class Router:
                  clock: Callable[[], float] = time.monotonic,
                  heartbeat_timeout: float = 30.0,
                  health_every: float = 2.0,
-                 max_redispatch: int = 3):
+                 max_redispatch: int = 3,
+                 rebalance_max: int = 4):
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self.policy = policy if policy is not None else policy_from_env()
@@ -307,6 +381,7 @@ class Router:
         self.heartbeat_timeout = float(heartbeat_timeout)
         self.health_every = float(health_every)
         self.max_redispatch = int(max_redispatch)
+        self.rebalance_max = int(rebalance_max)
         self.pending: deque[_InFlight] = deque()
         self.by_wire: Dict[str, _InFlight] = {}
         self.draining = False
@@ -437,8 +512,13 @@ class Router:
         Returns (client, event) pairs to deliver."""
         now = self._clock()
         for link in self.links:
-            if not link.up and not link.breaker.is_open:
-                self._try_connect(link, now)
+            if link.retired or link.up or link.breaker.is_open:
+                continue
+            if self._try_connect(link, now):
+                # a replica just turned HEALTHY (fresh spawn, scale-up,
+                # or breaker re-probe): proactively migrate a bounded
+                # amount of waiting work onto it
+                self._rebalance(link, now)
         for link in self.links:
             if not link.up:
                 continue
@@ -476,6 +556,10 @@ class Router:
         self.metrics.set_gauge("replicas_stale", stale)
         self.metrics.set_gauge(
             "replicas_up", sum(1 for link in self.links if link.up)
+        )
+        self.metrics.set_gauge(
+            "replicas_retired",
+            sum(1 for link in self.links if link.retired),
         )
         self.metrics.set_gauge("queue_depth", len(self.pending))
         self.metrics.set_gauge(
@@ -544,7 +628,7 @@ class Router:
         best = None
         best_key = None
         for link in self.links:
-            if not link.up:
+            if not link.up or link.retired:
                 continue
             load = len(link.inflight) + int(
                 link.health.get("queue_depth", 0)
@@ -665,13 +749,32 @@ class Router:
         elif kind == "rejected":
             link.inflight.pop(inf.wire, None)
             reason = str(ev.get("reason", "rejected"))
+            # a draining replica (scale-down mid-dispatch) is a router
+            # problem, not a client problem: retry elsewhere like a
+            # momentary queue_full
             if (
-                reason == REJECT_QUEUE_FULL
+                reason in (REJECT_QUEUE_FULL, REJECT_DRAINING)
                 and inf.retries < self.max_redispatch
             ):
                 self._requeue(inf, now, backoff=True)
             else:
                 self._shed(inf, reason, now, replica=link.index)
+        elif kind == "released":
+            inf.releasing = False
+            if not ev.get("released"):
+                return  # already decoding there; leave it be
+            # the replica dropped the request from its queue and
+            # journaled done(handed_off): ownership is the router's
+            # again, zero tokens were ever emitted (release only takes
+            # queued requests), so a fresh dispatch of the original
+            # payload is bit-identical. Front of the queue → the
+            # least-loaded pick lands it on the new replica this tick.
+            link.inflight.pop(inf.wire, None)
+            self.metrics.inc("rebalance_released")
+            self._route(ROUTE_HANDOFF, req=inf.public, resumed=False,
+                        rebalance=True, trace_id=inf.trace or None,
+                        **{"from": link.index})
+            self._requeue(inf, now, front=True)
 
     def _forward_token(self, inf: _InFlight, ev: dict) -> None:
         index = int(ev.get("index", -1))
@@ -739,6 +842,84 @@ class Router:
             self._tenants.pop(inf.tenant, None)
         else:
             self._tenants[inf.tenant] = left
+
+    # ----- fleet scaling & rebalance ---------------------------------------
+
+    def add_replica(self, spec: ReplicaSpec) -> int:
+        """Grow the fleet by one endpoint (autoscaler scale-up). The
+        link dials on the next poll; returns its index."""
+        index = len(self.links)
+        self.links.append(
+            ReplicaLink(index, spec, self.policy, self._clock)
+        )
+        self.metrics.inc("replicas_added")
+        return index
+
+    def retire_replica(self, index: int) -> int:
+        """Begin graceful scale-down of one replica: no new work goes
+        to it, its queued-but-not-decoding requests are released back
+        to the router, and in-flight decodes run to completion. The
+        caller reaps the process once ``links[index].inflight`` is
+        empty (or on its grace deadline — the EOF then rides the
+        normal handoff path, so nothing is lost either way). Returns
+        the in-flight count at retirement."""
+        link = self.links[index]
+        link.retired = True
+        self.metrics.inc("replicas_retired_total")
+        if link.up and link.inflight:
+            self._release_from(link, len(link.inflight), self._clock())
+        return len(link.inflight)
+
+    def revive_replica(self, index: int) -> None:
+        """Un-retire a link (autoscaler scale-up reusing a retired
+        slot): the breaker resets and the next poll re-dials it."""
+        link = self.links[index]
+        link.retired = False
+        link.breaker.record_success()
+
+    def _rebalance(self, link: ReplicaLink, now: float) -> None:
+        """Proactive migration onto a replica that just turned
+        HEALTHY. Router-queued work reaches it by itself (least-loaded
+        placement this very tick); what needs help is work already
+        QUEUED AT a busy donor. Ask the most-loaded peer to release a
+        bounded number of its token-less requests — each release
+        travels the journal-ownership path (the donor journals
+        ``done(handed_off)`` before answering), so a later replay of
+        the donor can never double-serve them."""
+        if self.rebalance_max <= 0:
+            return
+        donor = None
+        for other in self.links:
+            if other is link or not other.up or other.retired:
+                continue
+            if donor is None or len(other.inflight) > len(donor.inflight):
+                donor = other
+        if donor is None:
+            return
+        gap = len(donor.inflight) - len(link.inflight)
+        if gap < 2:
+            return  # balanced enough: a migration costs a round-trip
+        self._release_from(donor, min(self.rebalance_max, gap // 2), now)
+
+    def _release_from(self, donor: ReplicaLink, n: int,
+                      now: float) -> None:
+        """Send up to ``n`` release ctls to a live donor. Only
+        requests with zero forwarded tokens are candidates — the
+        replica-side release only takes QUEUED requests, so a granted
+        release guarantees the client saw nothing and a re-dispatch of
+        the original payload is bit-identical."""
+        victims = [
+            inf for inf in donor.inflight.values()
+            if inf.n_tokens == 0 and not inf.releasing
+        ]
+        for inf in victims[:n]:
+            try:
+                donor.send({"ctl": "release", "id": inf.wire})
+            except OSError:
+                self._replica_down(donor, "send_failed", now)
+                return
+            inf.releasing = True
+            self.metrics.inc("rebalance_requested")
 
     # ----- journal-ownership handoff ---------------------------------------
 

@@ -436,6 +436,42 @@ class Scheduler:
         self.metrics.set_gauge("queue_depth", 0)
         return n
 
+    def release(self, request_id: str) -> bool:
+        """Surrender ownership of ONE still-queued request back to the
+        caller (the router's rebalance / scale-down path). Only queued
+        requests are releasable — a request on a slot (or mid-chunked-
+        prefill) has device work sunk into it and may have streamed
+        tokens, so it finishes here. A granted release is a journal
+        settlement (``done(handed_off)``, same mark the router writes
+        when it folds a dead journal): a later ``--replay`` of this
+        process skips the request, so router and replay can never
+        double-serve it. Returns True iff the request was released."""
+        kept: deque[Tuple[Request, float]] = deque()
+        released = None
+        for req, t_submit in self._queue:
+            if released is None and req.id == request_id:
+                released = req
+            else:
+                kept.append((req, t_submit))
+        if released is None:
+            return False
+        self._queue = kept
+        self.metrics.inc("requests_released")
+        self.metrics.set_gauge("queue_depth", len(self._queue))
+        now = time.time()
+        self._req_event("n", released.id, "released", ts=now,
+                        trace=released.trace_id)
+        self._req_event("e", released.id, "queued", ts=now,
+                        trace=released.trace_id)
+        self._req_event("e", released.id, "request", ts=now,
+                        trace=released.trace_id, reason="released")
+        if self.journal is not None:
+            # journal.STATUS_HANDED_OFF (literal: journal.py imports
+            # this module, so the constant can't be imported here)
+            self.journal.done(released.id, "handed_off", 0,
+                              resumed_by="router")
+        return True
+
     def _serve_embed(self, req: Request, t_submit: float) -> None:
         """Answer an embed request at admission time: one full forward,
         no decode slot occupied, completion delivered by the next

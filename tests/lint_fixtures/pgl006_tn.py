@@ -89,6 +89,27 @@ def clean_prefix_cache_metrics(reg):
     reg.inc("prefix_cache_hit_tokens", 64)
 
 
+def clean_autoscale_metrics(reg):
+    # autoscale/rebalance METRICS are fine anywhere — only raw
+    # ev:"scale" decision records are restricted to fleet/autoscaler.py
+    reg.inc("replicas_added")
+    reg.set_gauge("replicas_retired", 1.0)
+    reg.inc("rebalance_requested")
+
+
+def clean_transport_metrics(reg):
+    # transport METRICS are fine anywhere — only raw ev:"frame_drop"
+    # records are restricted to fleet/transport.py
+    reg.inc("frames_in", 3)
+    reg.inc("accept_drops")
+
+
+def clean_scale_consumer(records):
+    # consuming scale records (the CI smoke, summarize) is fine — only
+    # building the raw dict literal is restricted
+    return [r for r in records if r.get("action") == "up"]
+
+
 def clean_other_ev_dict():
     # dict literals with other ev tags are not the collector's grammar
     return {"ev": "tsdb_block", "seq": 4, "level": 1}

@@ -1,4 +1,4 @@
-"""PGL006 true positives: telemetry hygiene. Expected findings: 31."""
+"""PGL006 true positives: telemetry hygiene. Expected findings: 38."""
 
 
 def unbounded_span(telemetry, name):
@@ -110,3 +110,29 @@ def bad_alert_everything():
     # the stale/fresh/warn/burning/resolved alphabet
     return {"ev": "alert", "ts": 1.0, "kind": "paging",
             "state": "screaming"}
+
+
+def raw_scale_record():
+    # TP: autoscaler decision record built outside fleet/autoscaler.py
+    # (bypasses the edge-triggered dedup and the cooldown bookkeeping)
+    return {"ev": "scale", "ts": 1.0, "action": "up",
+            "reason": "queue_depth", "current": 1, "target": 2}
+
+
+def bad_scale_everything():
+    # TP x3: outside fleet/autoscaler.py, missing the reason field, and
+    # an action outside the up/down/hold alphabet
+    return {"ev": "scale", "ts": 1.0, "action": "sideways"}
+
+
+def raw_frame_drop_record():
+    # TP: frame-drop record built outside fleet/transport.py — a drop
+    # record is the transport's proof a frame was condemned
+    return {"ev": "frame_drop", "ts": 1.0, "reason": "bad_auth"}
+
+
+def bad_frame_drop_reason():
+    # TP x2: outside fleet/transport.py AND a reason outside the
+    # bad_magic/bad_version/bad_auth/oversized/chaos/idle_timeout
+    # condemnation alphabet
+    return {"ev": "frame_drop", "ts": 1.0, "reason": "gremlins"}

@@ -1,0 +1,301 @@
+"""Fleet autoscaler unit tests: pure policy math (evaluate_policy),
+the stateful decide loop over a fake TSDB reader, TOML policy loading,
+and the edge-triggered scale-record discipline.
+
+jax-free on purpose — evaluate_policy is clock-free arithmetic and the
+Autoscaler only touches the collector's sample/series helpers, so CI
+runs these before any backend comes up.
+"""
+
+import pytest
+
+from progen_tpu.fleet.autoscaler import (
+    ACTION_DOWN,
+    ACTION_HOLD,
+    ACTION_UP,
+    Autoscaler,
+    ScalingPolicy,
+    evaluate_policy,
+    extract_signals,
+    load_policy,
+    read_scale_records,
+)
+from progen_tpu.resilience import chaos
+from progen_tpu.telemetry.collector import make_sample
+
+INF = float("inf")
+
+POLICY = ScalingPolicy(
+    min_replicas=1, max_replicas=3, queue_high=8.0, queue_low=1.0,
+    up_sustain=2, down_sustain=2, up_cooldown_s=10.0,
+    down_cooldown_s=30.0, stale_after_s=15.0,
+)
+
+
+def _eval(signals, current=1, age_s=0.0, streak=(0, 0),
+          since_up_s=INF, since_down_s=INF, policy=POLICY):
+    return evaluate_policy(policy, current, signals, age_s, streak,
+                           since_up_s, since_down_s)
+
+
+class TestPolicyValidation:
+    def test_watermarks_must_leave_a_band(self):
+        with pytest.raises(ValueError, match="hysteresis"):
+            ScalingPolicy(queue_high=2.0, queue_low=2.0)
+
+    def test_bounds_must_nest(self):
+        with pytest.raises(ValueError, match="min_replicas"):
+            ScalingPolicy(min_replicas=5, max_replicas=2)
+
+    def test_sustain_must_be_positive(self):
+        with pytest.raises(ValueError, match="sustain"):
+            ScalingPolicy(up_sustain=0)
+
+
+class TestEvaluatePolicy:
+    def test_no_data_holds_and_resets_streak(self):
+        d, streak = _eval(None, streak=(1, 5))
+        assert (d.action, d.reason) == (ACTION_HOLD, "no_data")
+        assert streak == (0, 0)
+
+    def test_stale_data_holds(self):
+        d, streak = _eval({"queue": 99.0}, age_s=15.1, streak=(1, 5))
+        assert (d.action, d.reason) == (ACTION_HOLD, "stale_data")
+        assert streak == (0, 0)
+
+    def test_hysteresis_band_holds(self):
+        # queue between low (1) and high (8): neither direction
+        d, streak = _eval({"queue": 4.0})
+        assert (d.action, d.reason) == (ACTION_HOLD, "steady")
+        assert streak == (0, 1)
+
+    def test_boundary_values_are_in_the_band(self):
+        # breach is strict: exactly AT a watermark holds on both sides
+        d, _ = _eval({"queue": 8.0})
+        assert d.action == ACTION_HOLD
+        d, _ = _eval({"queue": 1.0}, current=2)
+        assert d.action == ACTION_HOLD
+
+    def test_up_requires_sustain(self):
+        d, streak = _eval({"queue": 9.0}, streak=(0, 0))
+        assert (d.action, d.reason) == (ACTION_HOLD, "sustaining")
+        assert streak == (1, 1)
+        d, streak = _eval({"queue": 9.0}, streak=streak)
+        assert d.action == ACTION_UP
+        assert d.reason == "queue_high"
+        assert d.target == 2
+        assert streak == (1, 2)
+
+    def test_direction_flip_resets_streak(self):
+        # one tick of down-pressure after an up streak starts over
+        _, streak = _eval({"queue": 9.0}, streak=(0, 0))
+        d, streak = _eval({"queue": 0.0}, current=2, streak=streak)
+        assert streak == (-1, 1)
+        assert (d.action, d.reason) == (ACTION_HOLD, "sustaining")
+
+    def test_up_cooldown_gates(self):
+        d, _ = _eval({"queue": 9.0}, streak=(1, 1), since_up_s=9.9)
+        assert (d.action, d.reason) == (ACTION_HOLD, "cooldown")
+        d, _ = _eval({"queue": 9.0}, streak=(1, 1), since_up_s=10.0)
+        assert d.action == ACTION_UP
+
+    def test_at_max_holds_before_sustain_counting(self):
+        d, _ = _eval({"queue": 9.0}, current=3, streak=(1, 99))
+        assert (d.action, d.reason) == (ACTION_HOLD, "at_max_replicas")
+
+    def test_down_requires_sustain_cooldown_and_floor(self):
+        d, streak = _eval({"queue": 0.0}, current=2, streak=(0, 0))
+        assert (d.action, d.reason) == (ACTION_HOLD, "sustaining")
+        d, _ = _eval({"queue": 0.0}, current=2, streak=streak,
+                     since_down_s=29.0)
+        assert (d.action, d.reason) == (ACTION_HOLD, "cooldown")
+        d, _ = _eval({"queue": 0.0}, current=2, streak=streak)
+        assert d.action == ACTION_DOWN
+        assert d.reason == "queue_low"
+        assert d.target == 1
+
+    def test_at_min_holds(self):
+        d, _ = _eval({"queue": 0.0}, current=1, streak=(-1, 99))
+        assert (d.action, d.reason) == (ACTION_HOLD, "at_min_replicas")
+
+    def test_ttft_objective_scales_up(self):
+        policy = ScalingPolicy(
+            max_replicas=3, ttft_p95_high_s=0.5, up_sustain=1,
+        )
+        d, _ = _eval({"queue": 4.0, "ttft_p95_s": 0.9}, policy=policy)
+        assert (d.action, d.reason) == (ACTION_UP, "ttft_p95_high")
+
+    def test_itl_objective_scales_up(self):
+        policy = ScalingPolicy(
+            max_replicas=3, itl_p99_high_s=0.1, up_sustain=1,
+        )
+        d, _ = _eval({"queue": 4.0, "itl_p99_s": 0.3}, policy=policy)
+        assert (d.action, d.reason) == (ACTION_UP, "itl_p99_high")
+
+    def test_disabled_latency_objectives_ignored(self):
+        # default policy: 0 disables — a huge TTFT alone must not scale
+        d, _ = _eval({"queue": 4.0, "ttft_p95_s": 99.0}, streak=(1, 9))
+        assert (d.action, d.reason) == (ACTION_HOLD, "steady")
+
+
+class TestExtractSignals:
+    def test_fleet_series_keys(self):
+        out = extract_signals({
+            "queue_depth_sum": 7.0, "slot_occupancy_sum": 3.0,
+            "ttft_s_p95_s": 0.25, "itl_s_p99_s": 0.04,
+            "replicas_live": 2.0, "fleet_up": 2.0, "unrelated": 1.0,
+        })
+        assert out["queue"] == 7.0
+        assert out["slot_occupancy"] == 3.0
+        assert out["ttft_p95_s"] == 0.25
+        assert out["itl_p99_s"] == 0.04
+        assert out["replicas_live"] == 2.0
+        assert "unrelated" not in out
+
+    def test_single_source_fallback_keys(self):
+        out = extract_signals({"queue_depth": 2.0, "slot_occupancy": 1.0})
+        assert out == {"queue": 2.0, "slot_occupancy": 1.0}
+
+
+class TestLoadPolicy:
+    def test_roundtrip(self, tmp_path):
+        p = tmp_path / "autoscaler.toml"
+        p.write_text(
+            "[autoscaler]\nmin_replicas = 1\nmax_replicas = 3\n"
+            "queue_high = 6.0\nqueue_low = 0.5\nup_cooldown_s = 5.0\n"
+        )
+        policy = load_policy(p)
+        assert policy.max_replicas == 3
+        assert policy.queue_high == 6.0
+        assert policy.up_cooldown_s == 5.0
+        # unlisted knobs stay at defaults
+        assert policy.down_sustain == ScalingPolicy().down_sustain
+
+    def test_unknown_key_raises(self, tmp_path):
+        p = tmp_path / "autoscaler.toml"
+        p.write_text("[autoscaler]\nmax_replicsa = 3\n")
+        with pytest.raises(ValueError, match="max_replicsa"):
+            load_policy(p)
+
+    def test_shipped_example_loads(self):
+        from pathlib import Path
+
+        example = (Path(__file__).resolve().parents[1]
+                   / "configs" / "serving" / "autoscaler.toml")
+        policy = load_policy(example)
+        assert policy.max_replicas >= policy.min_replicas
+
+
+class _FakeReader:
+    """Stands in for TsdbReader: whatever samples the test staged."""
+
+    def __init__(self):
+        self.samples = []
+
+    def read(self):
+        return list(self.samples)
+
+
+def _stage(reader, ts, queue):
+    reader.samples.append(make_sample(
+        ts=ts, source="router", role="router", up=True, age_s=0.1,
+        gauges={"queue_depth": queue},
+    ))
+
+
+class TestAutoscalerLoop:
+    def _scaler(self):
+        reader = _FakeReader()
+        decisions = []
+        scaler = Autoscaler(POLICY, reader=reader,
+                            clock=lambda: 0.0, emit=decisions.append)
+        return scaler, reader, decisions
+
+    def test_no_reader_data_holds(self):
+        scaler, _, _ = self._scaler()
+        d = scaler.decide(1, now=100.0)
+        assert (d.action, d.reason) == (ACTION_HOLD, "no_data")
+
+    def test_scale_up_after_sustained_pressure(self):
+        scaler, reader, _ = self._scaler()
+        _stage(reader, 100.0, 12.0)
+        assert scaler.decide(1, now=100.0).action == ACTION_HOLD
+        _stage(reader, 102.0, 12.0)
+        d = scaler.decide(1, now=102.0)
+        assert (d.action, d.target) == (ACTION_UP, 2)
+        assert d.signals["queue"] == 12.0
+
+    def test_fresh_spawn_blocks_immediate_drain(self):
+        # anti-flap: since_down measures since the last action in
+        # EITHER direction — the up at t=102 holds the down until
+        # down_cooldown_s (30) has passed, even with sustained
+        # down-pressure
+        scaler, reader, _ = self._scaler()
+        _stage(reader, 100.0, 12.0)
+        scaler.decide(1, now=100.0)
+        _stage(reader, 102.0, 12.0)
+        assert scaler.decide(1, now=102.0).action == ACTION_UP
+        _stage(reader, 104.0, 0.0)
+        scaler.decide(2, now=104.0)  # sustain 1/2
+        _stage(reader, 106.0, 0.0)
+        d = scaler.decide(2, now=106.0)  # sustained, but 4s since up
+        assert (d.action, d.reason) == (ACTION_HOLD, "cooldown")
+        _stage(reader, 133.0, 0.0)
+        d = scaler.decide(2, now=133.0)  # 31s since the up: drain ok
+        assert (d.action, d.target) == (ACTION_DOWN, 1)
+
+    def test_stale_point_holds(self):
+        scaler, reader, _ = self._scaler()
+        _stage(reader, 100.0, 12.0)
+        d = scaler.decide(1, now=120.0)  # 20s > stale_after_s (15)
+        assert (d.action, d.reason) == (ACTION_HOLD, "stale_data")
+
+    def test_chaos_decide_raises_to_caller(self):
+        scaler, reader, _ = self._scaler()
+        _stage(reader, 100.0, 12.0)
+        chaos.install("autoscaler/decide:fail@1")
+        try:
+            with pytest.raises(chaos.ChaosError):
+                scaler.decide(1, now=100.0)
+        finally:
+            chaos.uninstall()
+        # the fault cost one tick, not the loop: next decide works
+        assert scaler.decide(1, now=100.0).action == ACTION_HOLD
+
+    def test_edge_triggered_emit(self):
+        # every up/down emits; repeated same-reason holds emit once
+        scaler, reader, decisions = self._scaler()
+        for i in range(3):
+            _stage(reader, 100.0 + i, 4.0)
+            scaler.decide(1, now=100.0 + i)
+        assert [d.reason for d in decisions] == ["steady"]
+        _stage(reader, 110.0, 12.0)
+        scaler.decide(1, now=110.0)  # hold: sustaining
+        _stage(reader, 112.0, 12.0)
+        scaler.decide(1, now=112.0)  # up
+        assert [d.action for d in decisions] == [
+            ACTION_HOLD, ACTION_HOLD, ACTION_UP,
+        ]
+
+
+class TestScaleRecords:
+    def test_records_written_and_read_back(self, tmp_path):
+        from progen_tpu import telemetry
+
+        events = tmp_path / "events.jsonl"
+        telemetry.configure(path=events)
+        try:
+            reader = _FakeReader()
+            scaler = Autoscaler(POLICY, reader=reader)
+            _stage(reader, 100.0, 12.0)
+            scaler.decide(1, now=100.0)  # hold: sustaining
+            _stage(reader, 102.0, 12.0)
+            scaler.decide(1, now=102.0)  # up
+        finally:
+            telemetry.configure(sink=None)
+        recs = read_scale_records(events)
+        assert [r["action"] for r in recs] == [ACTION_HOLD, ACTION_UP]
+        up = recs[-1]
+        assert up["reason"] == "queue_high"
+        assert (up["current"], up["target"]) == (1, 2)
+        assert up["queue"] == 12.0

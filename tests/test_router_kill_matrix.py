@@ -20,8 +20,13 @@ the whole fleet:
 
 These run REAL subprocesses: N ``cli/serve --socket`` replicas plus one
 ``cli/router`` front (a SIGKILL rule in-process would take pytest down
-with it). One mid-decode replica kill runs in tier-1; the prefill kill,
-router-site faults, and the parity sweep are ``slow``.
+with it). The same invariants hold per transport: one fleet case runs
+the victim over framed TCP (``--tcp`` / ``--replica tcp=``,
+progen_tpu/fleet/transport.py) to lock the wire-format claim that a
+SIGKILL mid-TCP-stream settles exactly once via ``--replay`` with
+bit-parity. One mid-decode replica kill per transport runs in tier-1;
+the prefill kill, router-site faults, and the parity sweep are
+``slow``.
 """
 
 import json
@@ -110,18 +115,67 @@ def _spawn_replica(ck, rdir, *, chaos="", replay=False):
 
 
 def _spawn_router(rdirs, *, chaos=""):
-    args = [sys.executable, "-m", "progen_tpu.cli.router"]
+    specs = []
     for rdir in rdirs:
         rdir = Path(rdir)
-        args += [
-            "--replica",
+        specs.append(
             f"sock={rdir / 'serve.sock'},journal={rdir},"
-            f"prom={rdir / 'metrics.prom'}",
-        ]
+            f"prom={rdir / 'metrics.prom'}"
+        )
+    return _spawn_router_specs(specs, chaos=chaos)
+
+
+def _spawn_router_specs(specs, *, chaos=""):
+    args = [sys.executable, "-m", "progen_tpu.cli.router"]
+    for spec in specs:
+        args += ["--replica", spec]
     return subprocess.Popen(
         args, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
         stderr=subprocess.PIPE, env=_env(chaos), text=True, bufsize=1,
     )
+
+
+def _spawn_replica_tcp(ck, rdir, *, chaos="", replay=False):
+    """A replica serving framed TCP on an ephemeral loopback port;
+    stderr goes to ``rdir/stderr.log`` so the bound port (and later the
+    replay report) can be read without racing a pipe."""
+    rdir = Path(rdir)
+    rdir.mkdir(parents=True, exist_ok=True)
+    args = [
+        sys.executable, "-m", "progen_tpu.cli.serve",
+        "--checkpoint_path", str(ck),
+        "--max-slots", "2", "--max-queue", "16", "--max-len", "24",
+        "--tcp", "127.0.0.1:0",
+        "--journal_dir", str(rdir),
+        "--prom_file", str(rdir / "metrics.prom"),
+        "--metrics-every", "2",
+    ]
+    if replay:
+        args += ["--replay", str(rdir)]
+    return subprocess.Popen(
+        args, stdin=subprocess.DEVNULL, stdout=subprocess.DEVNULL,
+        stderr=open(rdir / "stderr.log", "a"), env=_env(chaos),
+    )
+
+
+def _wait_tcp_port(proc, rdir, timeout_s=240, min_count=1):
+    """Block until the TCP replica prints its bound ephemeral port;
+    returns the ``host:port`` string. A replay rebirth appends a fresh
+    line to the same log, so its caller passes ``min_count=2`` — the
+    dead first life's line must not read as the new process being up."""
+    log = Path(rdir) / "stderr.log"
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        text = log.read_text() if log.exists() else ""
+        ports = re.findall(r"listening on tcp (\S+)", text)
+        if len(ports) >= min_count:
+            return ports[-1]
+        if proc.poll() is not None:
+            pytest.fail(
+                f"tcp replica died during startup: {text[-2000:]}"
+            )
+        time.sleep(0.25)
+    pytest.fail("tcp replica never printed its port")
 
 
 def _wait_sockets(procs_dirs, timeout_s=240):
@@ -372,6 +426,92 @@ class TestFleetKillMatrix:
                 reborn.kill()
         assert reborn.returncode == 0, err3[-2000:]
         assert "replay: resumed 0 request(s)" in err3, err3[-2000:]
+
+
+class TestTcpFleetKillMatrix:
+    def test_replica_sigkill_mid_tcp_stream_fleet_recovers(
+        self, workspace, tmp_path
+    ):
+        """The TCP twin of the tier-1 failover case: the victim serves
+        framed TCP (``--tcp``), the survivor a unix socket, and the
+        router fronts both in one fleet. Replica 0 SIGKILLs at its 6th
+        decode step mid-TCP-stream; every accepted request must settle
+        exactly once, the merged stream must stay bit-identical to the
+        references (the frame envelope is payload-transparent), and a
+        ``--replay`` rebirth of the victim must resume ZERO requests —
+        the journal/handoff machinery is transport-blind."""
+        rdirs = [tmp_path / "r0", tmp_path / "r1"]
+        victim = _spawn_replica_tcp(
+            workspace["ck"], rdirs[0], chaos="serve/decode:kill@6"
+        )
+        survivor = _spawn_replica(workspace["ck"], rdirs[1])
+        router = None
+        try:
+            hostport = _wait_tcp_port(victim, rdirs[0])
+            _wait_sockets([(survivor, rdirs[1])])
+            router = _spawn_router_specs([
+                f"tcp={hostport},journal={rdirs[0]},"
+                f"prom={rdirs[0] / 'metrics.prom'}",
+                f"sock={rdirs[1] / 'serve.sock'},journal={rdirs[1]},"
+                f"prom={rdirs[1] / 'metrics.prom'}",
+            ])
+            router.stdin.write("\n".join(_requests(4)) + "\n")
+            router.stdin.close()
+            out_lines, err_lines = [], []
+            assert _pump(
+                router, out_lines, err_lines,
+                lambda: all(
+                    t[2] for t in router._pump_tails.values()
+                ), 600,
+            ), (
+                "router did not drain:\n"
+                + "\n".join(err_lines)[-2000:]
+            )
+            router.wait(timeout=60)
+            assert router.returncode == 0, "\n".join(err_lines)[-2000:]
+            tokens, done, rejected = _parse_events(out_lines)
+        finally:
+            if router is not None and router.poll() is None:
+                router.kill()
+                router.wait()
+            for p in (victim, survivor):
+                if p.poll() is None:
+                    p.terminate()
+        # the kill really landed mid-TCP-stream
+        assert victim.wait(timeout=60) == -9
+        # exactly once across the fleet, nothing shed, no dup tokens
+        assert sorted(done) == ["r0", "r1", "r2", "r3"]
+        assert rejected == []
+        pairs = [(i, ix) for i, ix, _ in tokens]
+        assert len(set(pairs)) == len(pairs)
+        victim_accepts = _journal_accepts(rdirs[0])
+        assert victim_accepts, "kill@6 landed before any accept"
+        from progen_tpu.serving.journal import replay_requests
+
+        pending, finished, n_done = replay_requests(
+            Path(rdirs[0]) / "journal.jsonl"
+        )
+        assert pending == [] and finished == []
+        assert n_done == len(victim_accepts)
+        # bit-parity: the TCP frames carried the exact JSONL payloads
+        originals = _original_accepts(rdirs)
+        assert sorted(originals) == ["r0", "r1", "r2", "r3"]
+        _assert_parity(workspace, originals, tokens)
+        survivor.wait(timeout=120)  # SIGTERM'd above: let it drain
+        # a --replay rebirth over TCP resumes nothing: the router's
+        # handed_off ownership marks make double-serving impossible
+        reborn = _spawn_replica_tcp(
+            workspace["ck"], rdirs[0], replay=True
+        )
+        try:
+            _wait_tcp_port(reborn, rdirs[0], min_count=2)
+            reborn.terminate()
+            assert reborn.wait(timeout=120) == 0
+        finally:
+            if reborn.poll() is None:
+                reborn.kill()
+        log = (rdirs[0] / "stderr.log").read_text()
+        assert "replay: resumed 0 request(s)" in log, log[-2000:]
 
 
 @pytest.mark.slow
