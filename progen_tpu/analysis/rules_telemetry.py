@@ -84,6 +84,18 @@ dashboard query then matches nothing. Three checks:
     would claim enforcement that never ran; a literal ``reason`` must
     come from the ``bad_magic``/``bad_version``/``bad_auth``/
     ``oversized``/``chaos``/``idle_timeout`` alphabet.
+  * ``"ev": "notify"`` dict literals (alert delivery decisions) may
+    only be built in ``telemetry/alert_router.py`` — a notify record
+    claims the dedup/silence/rate pipeline ran; a hand-rolled one
+    forges a delivery the on-call never received. A literal ``status``
+    must come from the ``sent``/``failed``/``silenced``/``deduped``
+    delivery alphabet (the console counts and the CI egress smoke key
+    on exactly these).
+  * ``"ev": "ship"`` dict literals (TSDB retention-tier decisions) may
+    only be built in ``telemetry/tsdb.py`` — a ship record is the
+    shipper's proof a block's digest was verified into the archive
+    manifest; a literal ``op`` must come from the ``shipped``/
+    ``skipped``/``verify_failed`` alphabet.
 """
 
 from __future__ import annotations
@@ -158,6 +170,8 @@ class TelemetryHygieneRule(Rule):
     _SCALE_ACTIONS = ("up", "down", "hold")
     _DROP_REASONS = ("bad_magic", "bad_version", "bad_auth",
                      "oversized", "chaos", "idle_timeout")
+    _NOTIFY_STATUSES = ("sent", "failed", "silenced", "deduped")
+    _SHIP_OPS = ("shipped", "skipped", "verify_failed")
 
     def visit_Dict(self, node: ast.Dict) -> None:
         self.generic_visit(node)
@@ -262,6 +276,39 @@ class TelemetryHygieneRule(Rule):
                     "frame_drop record 'reason'",
                     "drop triage greps exactly this reason set; an "
                     "unknown reason is an invisible wire failure",
+                )
+            elif v.value == "notify":
+                if not self._in_module("telemetry/alert_router.py"):
+                    self.report(
+                        v,
+                        "raw notify record built outside "
+                        "telemetry/alert_router.py — a notify record "
+                        "claims the dedup/silence/rate pipeline ran; a "
+                        "hand-rolled one forges a delivery the on-call "
+                        "never received; go through AlertRouter",
+                    )
+                self._check_literal_member(
+                    node, "status", self._NOTIFY_STATUSES,
+                    "notify record 'status'",
+                    "the console's delivery counts and the CI egress "
+                    "smoke classify by exactly the "
+                    "sent/failed/silenced/deduped alphabet",
+                )
+            elif v.value == "ship":
+                if not self._in_module("telemetry/tsdb.py"):
+                    self.report(
+                        v,
+                        "raw ship record built outside "
+                        "telemetry/tsdb.py — a ship record is the "
+                        "shipper's proof a block's digest was verified "
+                        "into the archive manifest; a hand-rolled one "
+                        "claims history that was never tiered out",
+                    )
+                self._check_literal_member(
+                    node, "op", self._SHIP_OPS,
+                    "ship record 'op'",
+                    "retention triage greps exactly the "
+                    "shipped/skipped/verify_failed op set",
                 )
 
     def _check_span_name(self, node: ast.Call) -> None:

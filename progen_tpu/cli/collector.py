@@ -11,6 +11,14 @@ Sources come from repeatable ``--source name=...,role=...,prom=...``
 specs (the router's ``--replica`` syntax) or a flat TOML
 (``configs/serving/collector.toml`` is the shipped example); flags
 override config values.
+
+Egress (all optional, all non-blocking for the scrape loop):
+``--remote-write URL`` pushes the merged fleet series to a Prometheus
+remote-write receiver (bounded spool, drops counted); ``--alert-config
+TOML`` routes alert transitions through dedup/severity/silences to
+webhook/file/stderr sinks with a ``notifications.jsonl`` ledger;
+``--archive DIR`` ships sealed TSDB blocks verbatim (digest manifest)
+before the ring degrades them.
 """
 
 from __future__ import annotations
@@ -21,14 +29,19 @@ import time
 
 import click
 
+from progen_tpu.telemetry.alert_router import (
+    AlertRouter,
+    load_router_config,
+)
 from progen_tpu.telemetry.alerts import AlertSink
 from progen_tpu.telemetry.collector import (
     Collector,
     load_collector_config,
     parse_source_spec,
 )
+from progen_tpu.telemetry.remote_write import RemoteWriteBridge
 from progen_tpu.telemetry.slo import load_objectives
-from progen_tpu.telemetry.tsdb import RingTSDB
+from progen_tpu.telemetry.tsdb import BlockShipper, RingTSDB
 
 
 @click.command()
@@ -78,6 +91,24 @@ from progen_tpu.telemetry.tsdb import RingTSDB
     help="alerts JSONL path [default: <tsdb>/alerts.jsonl]",
 )
 @click.option(
+    "--remote-write", "remote_write_url", default=None,
+    help="push the merged fleet series to this HTTP endpoint "
+         "(Prometheus remote-write, JSON body; bounded spool, "
+         "never blocks the scrape loop)",
+)
+@click.option(
+    "--alert-config", "alert_config_path",
+    type=click.Path(exists=True, dir_okay=False), default=None,
+    help="alert router TOML ([alert_router] + [route_<name>] tables); "
+         "notifications ledger lands beside the alerts JSONL",
+)
+@click.option(
+    "--archive", "archive_dir",
+    type=click.Path(file_okay=False), default=None,
+    help="ship sealed TSDB blocks verbatim to this directory (digest "
+         "manifest) before the ring downsamples or drops them",
+)
+@click.option(
     "--max-ticks", type=int, default=0, show_default=True,
     help="stop after N scrapes (0 = run until SIGTERM/SIGINT)",
 )
@@ -86,7 +117,8 @@ from progen_tpu.telemetry.tsdb import RingTSDB
 )
 def main(
     tsdb_dir, source_specs, config_path, interval, stale_after,
-    budget_bytes, block_bytes, slo_path, alerts_out, max_ticks, once,
+    budget_bytes, block_bytes, slo_path, alerts_out,
+    remote_write_url, alert_config_path, archive_dir, max_ticks, once,
 ):
     """Scrape fleet metrics sources into a bounded TSDB + alert sink."""
     settings = {}
@@ -121,22 +153,45 @@ def main(
         slo_path = settings.get("slo") or None
     cfg = load_objectives(slo_path) if slo_path else None
 
-    tsdb = RingTSDB(
-        tsdb_dir, budget_bytes=budget_bytes, block_bytes=block_bytes
+    shipper = (
+        BlockShipper(archive_dir) if archive_dir is not None else None
     )
-    alerts = AlertSink(
+    tsdb = RingTSDB(
+        tsdb_dir, budget_bytes=budget_bytes, block_bytes=block_bytes,
+        shipper=shipper,
+    )
+    alerts_path = (
         alerts_out if alerts_out is not None
         else tsdb.root / "alerts.jsonl"
     )
+    router = None
+    if alert_config_path is not None:
+        severity, routes = load_router_config(alert_config_path)
+        router = AlertRouter(
+            tsdb.root / "notifications.jsonl", routes,
+            severity=severity,
+        )
+    alerts = AlertSink(
+        alerts_path,
+        relay=router.handle if router is not None else None,
+    )
+    bridge = (
+        RemoteWriteBridge(remote_write_url)
+        if remote_write_url else None
+    )
     coll = Collector(
         tsdb, sources, stale_after_s=stale_after,
-        slo_cfg=cfg, alerts=alerts,
+        slo_cfg=cfg, alerts=alerts, remote_write=bridge,
     )
     click.echo(
         f"collector: {len(sources)} sources -> {tsdb.root} "
         f"(every {interval:g}s, stale after {stale_after:g}s, "
         f"budget {budget_bytes} B"
-        + (", fleet SLOs on" if cfg else "") + ")",
+        + (", fleet SLOs on" if cfg else "")
+        + (f", remote-write {remote_write_url}" if bridge else "")
+        + (f", {len(router.routes)} alert routes" if router else "")
+        + (f", archive {archive_dir}" if shipper else "")
+        + ")",
         err=True,
     )
 
@@ -161,10 +216,32 @@ def main(
     finally:
         tsdb.close()
         alerts.close()
+        if router is not None:
+            router.close()
+    tail = ""
+    if bridge is not None:
+        s = bridge.stats()
+        tail += (
+            f", remote-write {s['sent_points']} pts sent "
+            f"({s['dropped_points']} dropped, "
+            f"{s['push_failures']} push failures)"
+        )
+    if router is not None:
+        tail += (
+            f", notify {router.counts['sent']} sent / "
+            f"{router.counts['silenced']} silenced / "
+            f"{router.counts['deduped']} deduped"
+        )
+    if shipper is not None:
+        tail += (
+            f", archive {shipper.shipped} shipped / "
+            f"{shipper.skipped} skipped / "
+            f"{shipper.verify_failed} verify-failed"
+        )
     click.echo(
         f"collector: {ticks} ticks, {len(tsdb.blocks())} blocks, "
         f"{tsdb.total_bytes()} bytes, "
-        f"{tsdb.dropped_lines} torn lines dropped",
+        f"{tsdb.dropped_lines} torn lines dropped" + tail,
         err=True,
     )
     sys.exit(0)

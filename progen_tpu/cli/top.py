@@ -2,8 +2,11 @@
 
 Opens the store READ-ONLY (never races the collector) and renders the
 ``console.build_snapshot`` view: per-source up/age/slots/queue/latency
-rows, the fleet rollup, SLO burn states, recent alerts, and the TSDB's
-own health line.
+rows, the fleet rollup, SLO burn states, recent alerts (annotated with
+routed/silenced delivery state when an alert-router ledger exists), a
+notifications tail with delivery counts, and the TSDB's own health
+line. ``--alerts-only`` drops the source/fleet tables for an on-call
+terminal.
 
 Keys (watch mode): ``q`` quits; any other key refreshes immediately.
 ``--once`` renders a single frame; ``--once --json`` dumps the exact
@@ -52,34 +55,54 @@ from progen_tpu.telemetry.tsdb import TsdbReader
     help="with --once: print the snapshot as JSON instead of ANSI",
 )
 @click.option(
+    "--notifications", "notifications_path",
+    type=click.Path(dir_okay=False), default=None,
+    help="alert-router ledger [default: <tsdb>/notifications.jsonl "
+         "when present]",
+)
+@click.option(
+    "--alerts-only", is_flag=True,
+    help="render only the SLO/alert/notification panes (on-call view)",
+)
+@click.option(
     "--color/--no-color", default=None,
     help="force ANSI color on/off [default: on for TTYs]",
 )
 def main(tsdb_dir, slo_path, alerts_path, refresh, frames, once,
-         json_out, color):
+         json_out, notifications_path, alerts_only, color):
     """Live ANSI dashboard (or one-shot JSON) for the metrics fleet."""
     tsdb = TsdbReader(tsdb_dir)
     cfg = load_objectives(slo_path) if slo_path else None
     if alerts_path is None:
         default_alerts = tsdb.root / "alerts.jsonl"
         alerts_path = default_alerts if default_alerts.exists() else None
+    if notifications_path is None:
+        default_notes = tsdb.root / "notifications.jsonl"
+        notifications_path = (
+            default_notes if default_notes.exists() else None
+        )
     if color is None:
         color = sys.stdout.isatty()
     if json_out and not once:
         raise click.UsageError("--json requires --once")
     if once:
         snap = console_mod.build_snapshot(
-            tsdb, slo_cfg=cfg, alerts_path=alerts_path
+            tsdb, slo_cfg=cfg, alerts_path=alerts_path,
+            notifications_path=notifications_path,
         )
         if json_out:
             click.echo(console_mod.snapshot_json(snap))
         else:
-            click.echo(console_mod.render(snap, color=color))
+            click.echo(console_mod.render(
+                snap, color=color, alerts_only=alerts_only
+            ))
         return
     console_mod.watch(
         tsdb, slo_cfg=cfg, alerts_path=alerts_path,
         refresh_s=refresh, color=color,
         max_frames=frames if frames > 0 else None,
+        notifications_path=notifications_path,
+        alerts_only=alerts_only,
     )
 
 

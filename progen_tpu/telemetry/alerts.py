@@ -16,12 +16,24 @@ The sink file uses the journal's write discipline (append, one line,
 flush) so a tail -f or a webhook relay can follow it live; ``ev:
 "alert"`` records are built only here (PGL006 enforces the grammar:
 kind/state alphabets, source/objective always present).
+
+Edge-triggering survives restarts: the sink persists its last-known
+state per alert identity (``kind|source|objective``) in a small JSON
+file beside ``alerts.jsonl`` and reloads it on start, so a restarted
+collector neither re-fires an alert for a condition it already
+reported (``suppressed`` counts those) nor misses the recovery edge of
+a condition that flipped while it was down. An optional ``relay``
+callable (the alert router) receives every record that survives the
+dedup.
 """
 
 from __future__ import annotations
 
+import json
+import os
 import time
-from typing import List, Optional
+from pathlib import Path
+from typing import Callable, Dict, List, Optional
 
 from progen_tpu.telemetry.spans import EventLog
 
@@ -31,21 +43,74 @@ ALERT_STATES = ("stale", "fresh", "warn", "burning", "resolved")
 
 class AlertSink:
     """Append-only ``ev:"alert"`` writer over an :class:`EventLog`;
-    keeps the most recent records in memory for the console."""
+    keeps the most recent records in memory for the console and the
+    last state per alert identity on disk for restart dedup."""
 
-    def __init__(self, path, keep: int = 64):
+    def __init__(
+        self,
+        path,
+        keep: int = 64,
+        state_path=None,
+        relay: Optional[Callable[[dict], object]] = None,
+    ):
         self._log = EventLog(path)
         self.path = self._log.path
         self.keep = int(keep)
         self.recent: List[dict] = []
+        self.relay = relay
+        self.suppressed = 0
+        self.state_path = (
+            Path(state_path) if state_path
+            else self.path.with_suffix(".state.json")
+        )
+        try:
+            self._states: Dict[str, str] = json.loads(
+                self.state_path.read_text()
+            )
+        except (OSError, ValueError):
+            self._states = {}
 
     def close(self) -> None:
         self._log.close()
 
-    def _emit(self, rec: dict) -> dict:
+    @staticmethod
+    def _key(kind: str, source: str, objective: str = "") -> str:
+        return f"{kind}|{source}|{objective}"
+
+    def last_state(
+        self, kind: str, source: str, objective: str = ""
+    ) -> Optional[str]:
+        return self._states.get(self._key(kind, source, objective))
+
+    def last_states(self, kind: str) -> Dict[str, str]:
+        """``{source-or-objective: state}`` for one alert kind — what
+        the collector seeds its transition detectors from on start."""
+        out: Dict[str, str] = {}
+        for key, state in self._states.items():
+            k, source, objective = key.split("|", 2)
+            if k == kind:
+                out[objective if k == "slo_burn" else source] = state
+        return out
+
+    def _save_states(self) -> None:
+        tmp = self.state_path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(self._states, sort_keys=True))
+        os.replace(tmp, self.state_path)
+
+    def _emit(self, rec: dict) -> Optional[dict]:
+        key = self._key(rec["kind"], rec["source"], rec["objective"])
+        if self._states.get(key) == rec["state"]:
+            # identical state already on record (typically: a restart
+            # replayed the same transition) — the alert fired once
+            self.suppressed += 1
+            return None
+        self._states[key] = rec["state"]
+        self._save_states()
         self._log.emit(rec)
         self.recent.append(rec)
         del self.recent[: -self.keep]
+        if self.relay is not None:
+            self.relay(rec)
         return rec
 
     def staleness(

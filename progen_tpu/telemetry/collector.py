@@ -41,6 +41,7 @@ from dataclasses import dataclass
 from pathlib import Path
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
+from progen_tpu.telemetry.remote_write import fleet_kinds
 from progen_tpu.telemetry.slo import (
     SloConfig,
     SloWatch,
@@ -290,6 +291,7 @@ class Collector:
         slo_cfg: Optional[SloConfig] = None,
         alerts=None,
         window_s: Optional[float] = None,
+        remote_write=None,
     ):
         names = [s.name for s in sources]
         if len(set(names)) != len(names):
@@ -299,6 +301,7 @@ class Collector:
         self.stale_after_s = float(stale_after_s)
         self.slo_cfg = slo_cfg
         self.alerts = alerts
+        self.remote_write = remote_write
         self._tails = {
             s.name: _Tail(s.metrics) for s in self.sources if s.metrics
         }
@@ -312,6 +315,17 @@ class Collector:
         self._watch = (
             SloWatch(slo_cfg, emit=self._emit_slo) if slo_cfg else None
         )
+        # restart continuity: seed the transition detectors from the
+        # sink's persisted states so an edge that happened while this
+        # collector was down still fires (and a condition it already
+        # reported does not re-fire)
+        if alerts is not None and hasattr(alerts, "last_states"):
+            for name, state in alerts.last_states("staleness").items():
+                if name in set(names):
+                    self._up_last[name] = 1 if state == "fresh" else 0
+            if self._watch is not None:
+                for obj, state in alerts.last_states("slo_burn").items():
+                    self._watch.seed(obj, state)
 
     # -- scraping ---------------------------------------------------------
 
@@ -394,10 +408,17 @@ class Collector:
                 r for r in self._window if r["ts"] >= cutoff
             ]
         self._staleness_transitions(samples, now)
-        if self._watch is not None:
+        fleet = None
+        if self._watch is not None or self.remote_write is not None:
             fleet = fleet_series(self._window)
+        if self._watch is not None:
             results = evaluate(self.slo_cfg, [fleet], now=now)
             self._watch.observe(results, now=now)
+        if self.remote_write is not None and fleet:
+            counters, timings = fleet_kinds(self._window)
+            t, vals = fleet[-1]
+            self.remote_write.offer(t, vals, counters, timings)
+            self.remote_write.flush(now)
         return samples
 
     # -- alerting ---------------------------------------------------------

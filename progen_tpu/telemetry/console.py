@@ -14,7 +14,12 @@ asserts against exactly what an operator would see:
     path as ``slo-report --tsdb``);
   * the alert tail and the TSDB's own health (blocks, bytes, torn
     lines dropped) — a console that silently lost history is itself
-    an outage.
+    an outage;
+  * when an alert-router ledger exists, a notifications tail plus the
+    delivery state-machine counts (``sent``/``failed``/``silenced``/
+    ``deduped`` and the ``routed`` total) so CI can assert WHO was
+    told, not just what fired; ``--alerts-only`` renders just the
+    alerting panes for an on-call terminal.
 
 Rendering is pure string-building (no curses): the watch loop clears
 the screen between frames, which keeps the console dumb enough to pipe.
@@ -45,6 +50,8 @@ def build_snapshot(
     slo_cfg=None,
     alerts_path=None,
     max_alerts: int = 8,
+    notifications_path=None,
+    max_notifications: int = 8,
 ) -> dict:
     """Everything the console shows, as one JSON-able dict."""
     drops = LineDrops()
@@ -82,6 +89,26 @@ def build_snapshot(
             ][-max_alerts:]
         except OSError:
             pass
+    notifications: List[dict] = []
+    notify_counts = {
+        "sent": 0, "failed": 0, "silenced": 0, "deduped": 0, "routed": 0
+    }
+    if notifications_path is not None:
+        try:
+            all_notes = [
+                rec for rec in iter_jsonl(notifications_path, drops)
+                if rec.get("ev") == "notify"
+            ]
+        except OSError:
+            all_notes = []
+        for rec in all_notes:
+            status = rec.get("status", "")
+            if status in notify_counts:
+                notify_counts[status] += 1
+        notify_counts["routed"] = (
+            notify_counts["sent"] + notify_counts["failed"]
+        )
+        notifications = all_notes[-max_notifications:]
     return {
         "as_of": as_of,
         "sources": sources,
@@ -89,6 +116,8 @@ def build_snapshot(
         "slo": slo,
         "slo_exit": gate,
         "alerts": alerts,
+        "notifications": notifications,
+        "notify_counts": notify_counts,
         "tsdb": {
             "blocks": len(tsdb.blocks()),
             "bytes": tsdb.total_bytes(),
@@ -114,9 +143,27 @@ def _tq(rec: dict, fam: str, key: str):
     return rec.get("timings", {}).get(fam, {}).get(key)
 
 
-def render(snap: dict, color: bool = True) -> str:
+def _delivery_tags(snap: dict) -> Dict[tuple, List[str]]:
+    """(kind, who, state) → delivery statuses seen in the notification
+    tail, so the alerts pane can show routed/silenced state inline."""
+    tags: Dict[tuple, List[str]] = {}
+    for n in snap.get("notifications", []):
+        key = (
+            n.get("kind", ""),
+            n.get("objective") or n.get("source") or "",
+            n.get("state", ""),
+        )
+        status = n.get("status", "")
+        if status and status not in tags.setdefault(key, []):
+            tags[key].append(status)
+    return tags
+
+
+def render(snap: dict, color: bool = True,
+           alerts_only: bool = False) -> str:
     """Snapshot → dashboard text (no trailing clear; the watch loop
-    owns the screen)."""
+    owns the screen). ``alerts_only`` keeps the header, SLO, alert and
+    notification panes and drops the per-source/fleet tables."""
     lines: List[str] = []
     as_of = snap.get("as_of")
     stamp = (
@@ -128,6 +175,9 @@ def render(snap: dict, color: bool = True) -> str:
     n_all = int(fleet.get("fleet_sources", 0))
     head = f"progen-tpu-top  as of {stamp}  sources {n_up}/{n_all} up"
     lines.append(_c(head, _BOLD, color))
+    if alerts_only:
+        lines.extend(_render_alert_panes(snap, color))
+        return "\n".join(lines)
     hdr = (
         f"{'SOURCE':<10} {'ROLE':<8} {'UP':<5} {'AGE':>6} {'SLOTS':>6} "
         f"{'QUEUE':>6} {'TTFT95':>8} {'ITL95':>8} {'DONE':>8} {'TOKENS':>9}"
@@ -159,6 +209,20 @@ def render(snap: dict, color: bool = True) -> str:
         f"ttft p95 {_num(fleet.get('ttft_s_p95_s'), '{:.3f}')}s  "
         f"queue max {_num(fleet.get('queue_depth'))}"
     )
+    lines.extend(_render_alert_panes(snap, color))
+    t = snap.get("tsdb", {})
+    lines.append(_c(
+        f"tsdb: {t.get('blocks', 0)} blocks, {t.get('bytes', 0)} bytes, "
+        f"{t.get('dropped_lines', 0)} torn lines dropped",
+        _DIM, color,
+    ))
+    return "\n".join(lines)
+
+
+def _render_alert_panes(snap: dict, color: bool) -> List[str]:
+    """SLO states, the alert tail (annotated with delivery status when
+    a router ledger is present), and the notifications tail."""
+    lines: List[str] = []
     slo = snap.get("slo", [])
     if slo:
         lines.append(_c("SLO", _BOLD, color))
@@ -174,6 +238,7 @@ def render(snap: dict, color: bool = True) -> str:
                 f"burn {_num(burn, '{:.2f}')}"
                 + (f"  ({r['detail']})" if r.get("detail") else "")
             )
+    tags = _delivery_tags(snap)
     alerts = snap.get("alerts", [])
     if alerts:
         lines.append(_c("recent alerts", _BOLD, color))
@@ -184,17 +249,42 @@ def render(snap: dict, color: bool = True) -> str:
             who = a.get("objective") or a.get("source") or "?"
             state = a.get("state", "?")
             code = _GREEN if state in ("fresh", "resolved") else _RED
+            delivered = tags.get((a.get("kind", ""), who, state), [])
+            suffix = (
+                "  [" + ",".join(delivered) + "]" if delivered else ""
+            )
             lines.append(
                 f"  {ts} {a.get('kind', '?'):<10} {who:<18} "
                 f"{_c(state, code, color)}"
+                + _c(suffix, _DIM, color)
             )
-    t = snap.get("tsdb", {})
-    lines.append(_c(
-        f"tsdb: {t.get('blocks', 0)} blocks, {t.get('bytes', 0)} bytes, "
-        f"{t.get('dropped_lines', 0)} torn lines dropped",
-        _DIM, color,
-    ))
-    return "\n".join(lines)
+    notes = snap.get("notifications", [])
+    if notes:
+        counts = snap.get("notify_counts", {})
+        lines.append(_c(
+            "notifications  "
+            f"routed {counts.get('routed', 0)}  "
+            f"silenced {counts.get('silenced', 0)}  "
+            f"deduped {counts.get('deduped', 0)}  "
+            f"failed {counts.get('failed', 0)}",
+            _BOLD, color,
+        ))
+        for n in notes[-5:]:
+            ts = time.strftime(
+                "%H:%M:%S", time.localtime(n.get("ts", 0))
+            )
+            status = n.get("status", "?")
+            code = {
+                "sent": _GREEN, "failed": _RED, "silenced": _YELLOW
+            }.get(status, _DIM)
+            route = n.get("route") or "-"
+            lines.append(
+                f"  {ts} {route:<10} "
+                f"{n.get('fingerprint', '?'):<28} "
+                f"{n.get('state', '?'):<9} {_c(status, code, color)}"
+                + (f" ({n['reason']})" if n.get("reason") else "")
+            )
+    return lines
 
 
 def snapshot_json(snap: dict) -> str:
@@ -209,6 +299,8 @@ def watch(
     color: bool = True,
     max_frames: Optional[int] = None,
     out=None,
+    notifications_path=None,
+    alerts_only: bool = False,
 ):
     """Live loop: clear screen, render, wait. ``q`` quits when stdin is
     a TTY; otherwise runs until ``max_frames`` (None = forever) — the
@@ -219,9 +311,14 @@ def watch(
     frames = 0
     while max_frames is None or frames < max_frames:
         snap = build_snapshot(
-            tsdb, slo_cfg=slo_cfg, alerts_path=alerts_path
+            tsdb, slo_cfg=slo_cfg, alerts_path=alerts_path,
+            notifications_path=notifications_path,
         )
-        out.write(CLEAR_SCREEN + render(snap, color=color) + "\n")
+        out.write(
+            CLEAR_SCREEN
+            + render(snap, color=color, alerts_only=alerts_only)
+            + "\n"
+        )
         out.flush()
         frames += 1
         if max_frames is not None and frames >= max_frames:

@@ -474,6 +474,15 @@ class SloWatch:
         self._emit = emit
         self._last: Dict[str, str] = {}
 
+    def seed(self, objective: str, state: str) -> None:
+        """Prime the transition detector from persisted alert state
+        (restart continuity): a watcher that reboots mid-burn must not
+        re-announce the burn, and a persisted ``resolved`` means the
+        objective is currently ok."""
+        self._last[str(objective)] = (
+            STATE_OK if state == STATE_RESOLVED else str(state)
+        )
+
     def observe(
         self, results: Sequence[SloResult], now: Optional[float] = None
     ) -> List[dict]:

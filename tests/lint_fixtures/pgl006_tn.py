@@ -110,6 +110,26 @@ def clean_scale_consumer(records):
     return [r for r in records if r.get("action") == "up"]
 
 
+def clean_notify_metrics(reg):
+    # delivery METRICS are fine anywhere — only raw ev:"notify"
+    # records are restricted to telemetry/alert_router.py
+    reg.inc("notifications_sent")
+    reg.inc("notifications_silenced")
+
+
+def clean_notify_consumer(records):
+    # consuming notify records (console tail, CI asserts) is fine —
+    # only building the raw dict literal is restricted
+    return [r for r in records if r.get("status") == "sent"]
+
+
+def clean_ship_metrics(reg):
+    # retention METRICS are fine anywhere — only raw ev:"ship"
+    # records are restricted to telemetry/tsdb.py
+    reg.inc("blocks_shipped")
+    reg.set_gauge("archive_bytes", 1 << 20)
+
+
 def clean_other_ev_dict():
     # dict literals with other ev tags are not the collector's grammar
     return {"ev": "tsdb_block", "seq": 4, "level": 1}

@@ -1,4 +1,4 @@
-"""PGL006 true positives: telemetry hygiene. Expected findings: 38."""
+"""PGL006 true positives: telemetry hygiene. Expected findings: 44."""
 
 
 def unbounded_span(telemetry, name):
@@ -136,3 +136,31 @@ def bad_frame_drop_reason():
     # bad_magic/bad_version/bad_auth/oversized/chaos/idle_timeout
     # condemnation alphabet
     return {"ev": "frame_drop", "ts": 1.0, "reason": "gremlins"}
+
+
+def raw_notify_record(log):
+    # TP: notify record built outside telemetry/alert_router.py — it
+    # claims the dedup/silence/rate pipeline ran when it never did
+    log.emit({"ev": "notify", "ts": 1.0, "route": "ops",
+              "status": "sent", "fingerprint": "staleness:r0:"})
+
+
+def bad_notify_status():
+    # TP x2: outside telemetry/alert_router.py AND a status outside
+    # the sent/failed/silenced/deduped delivery alphabet
+    return {"ev": "notify", "ts": 1.0, "route": "ops",
+            "status": "queued", "fingerprint": "staleness:r0:"}
+
+
+def raw_ship_record():
+    # TP: ship record built outside telemetry/tsdb.py — it claims a
+    # block's digest was verified into the archive manifest
+    return {"ev": "ship", "ts": 1.0, "op": "shipped",
+            "block": "block-00000001-l0.jsonl"}
+
+
+def bad_ship_op():
+    # TP x2: outside telemetry/tsdb.py AND an op outside the
+    # shipped/skipped/verify_failed retention alphabet
+    return {"ev": "ship", "ts": 1.0, "op": "uploaded",
+            "block": "block-00000001-l0.jsonl"}

@@ -1,0 +1,309 @@
+"""Alert router (telemetry/alert_router.py): fingerprints, dedup
+across repeats, severity mapping, per-route silence windows and rate
+limits, webhook retry/backoff honoring PROGEN_RETRY_*, the
+notifications ledger, and restart state reload — plus the AlertSink
+persistence fix (no re-fire after a collector restart). Jax-free;
+webhook targets are an in-process stdlib HTTP server."""
+
+import json
+import tempfile
+from pathlib import Path
+
+import pytest
+
+from progen_tpu.telemetry.alert_router import (
+    AlertRouter,
+    RouteSpec,
+    fingerprint,
+    load_router_config,
+    read_notifications,
+)
+from progen_tpu.telemetry.alerts import AlertSink
+from tests.test_remote_write import _Receiver
+
+
+def _alert(kind="staleness", state="stale", source="r0",
+           objective="", ts=100.0):
+    # the sink builds real records; tests route through it so the
+    # PGL006 ownership contract holds in the test corpus too
+    with tempfile.TemporaryDirectory() as d:
+        sink = AlertSink(Path(d) / "alerts.jsonl")
+        if kind == "staleness":
+            rec = sink.staleness(source, up=(state == "fresh"),
+                                 age_s=0.0, now=ts)
+        else:
+            rec = sink.slo_transition(
+                {"objective": objective, "state": state, "ts": ts}
+            )
+        sink.close()
+    return rec
+
+
+def _router(tmp_path, routes, **kw):
+    return AlertRouter(tmp_path / "notifications.jsonl", routes, **kw)
+
+
+@pytest.fixture()
+def receiver():
+    r = _Receiver()
+    yield r
+    r.close()
+
+
+class TestFingerprint:
+    def test_stable_identity(self):
+        a = _alert(ts=1.0)
+        b = _alert(ts=999.0, state="fresh")
+        assert fingerprint(a) == fingerprint(b) == "staleness:r0:"
+        assert fingerprint(_alert(kind="slo_burn", source="fleet",
+                                  objective="ttft_p95")) \
+            == "slo_burn:fleet:ttft_p95"
+
+
+class TestConfig:
+    def test_shipped_example_parses(self):
+        sev, routes = load_router_config(
+            "configs/serving/alert_router.toml"
+        )
+        assert {r.name for r in routes} == {"ledger", "chat", "pager"}
+        assert sev["stale"] == "critical"
+
+    def test_unknown_route_key_raises(self, tmp_path):
+        p = tmp_path / "r.toml"
+        p.write_text('[route_x]\nsink = "file"\nsilences = 1.0\n')
+        with pytest.raises(ValueError, match="silences"):
+            load_router_config(p)
+
+    def test_unknown_table_raises(self, tmp_path):
+        p = tmp_path / "r.toml"
+        p.write_text('[routes_x]\nsink = "file"\n')
+        with pytest.raises(ValueError, match="routes_x"):
+            load_router_config(p)
+
+    def test_severity_override_and_bad_values(self, tmp_path):
+        p = tmp_path / "r.toml"
+        p.write_text(
+            '[alert_router]\nseverity_stale = "warning"\n'
+            '[route_x]\nsink = "file"\n'
+        )
+        sev, _ = load_router_config(p)
+        assert sev["stale"] == "warning"
+        p.write_text('[alert_router]\nseverity_stale = "mega"\n'
+                     '[route_x]\nsink = "file"\n')
+        with pytest.raises(ValueError, match="mega"):
+            load_router_config(p)
+
+    def test_webhook_requires_url(self):
+        with pytest.raises(ValueError, match="url"):
+            RouteSpec(name="w", sink="webhook")
+
+    def test_no_routes_raises(self, tmp_path):
+        p = tmp_path / "r.toml"
+        p.write_text("[alert_router]\n")
+        with pytest.raises(ValueError, match="route"):
+            load_router_config(p)
+
+
+class TestPipeline:
+    def test_dedup_across_repeats(self, tmp_path):
+        router = _router(tmp_path, [RouteSpec(name="ops")])
+        first = router.handle(_alert(ts=1.0))
+        assert [n["status"] for n in first] == ["sent"]
+        repeat = router.handle(_alert(ts=2.0))
+        assert [n["status"] for n in repeat] == ["deduped"]
+        assert repeat[0]["route"] == ""
+        # a STATE CHANGE is a new edge, not a repeat
+        recovery = router.handle(_alert(ts=3.0, state="fresh"))
+        assert [n["status"] for n in recovery] == ["sent"]
+        router.close()
+
+    def test_min_severity_floor(self, tmp_path):
+        router = _router(tmp_path, [
+            RouteSpec(name="all", min_severity="info"),
+            RouteSpec(name="page", min_severity="critical"),
+        ])
+        notes = router.handle(
+            _alert(kind="slo_burn", source="fleet",
+                   objective="o", state="warn")
+        )
+        assert [(n["route"], n["status"]) for n in notes] == \
+            [("all", "sent")]
+        notes = router.handle(
+            _alert(kind="slo_burn", source="fleet",
+                   objective="o", state="burning", ts=2.0)
+        )
+        assert {(n["route"], n["status"]) for n in notes} == \
+            {("all", "sent"), ("page", "sent")}
+        router.close()
+
+    def test_kind_filter(self, tmp_path):
+        router = _router(tmp_path, [
+            RouteSpec(name="slo_only", kinds="slo_burn"),
+        ])
+        assert router.handle(_alert()) == []
+        assert router.counts["sent"] == 0
+        router.close()
+
+    def test_silence_window_per_fingerprint(self, tmp_path):
+        router = _router(tmp_path, [
+            RouteSpec(name="fast"),
+            RouteSpec(name="quiet", silence_s=100.0),
+        ])
+        router.handle(_alert(ts=10.0, state="stale"))
+        notes = router.handle(_alert(ts=20.0, state="fresh"))
+        by_route = {n["route"]: n for n in notes}
+        assert by_route["fast"]["status"] == "sent"
+        assert by_route["quiet"]["status"] == "silenced"
+        assert by_route["quiet"]["reason"] == "silence_window"
+        # past the window the route wakes up again
+        notes = router.handle(_alert(ts=150.0, state="stale"))
+        assert {n["status"] for n in notes} == {"sent"}
+        # a DIFFERENT fingerprint is never silenced by this one
+        notes = router.handle(_alert(ts=151.0, source="r1"))
+        assert {n["status"] for n in notes} == {"sent"}
+        router.close()
+
+    def test_rate_limit(self, tmp_path):
+        router = _router(tmp_path, [
+            RouteSpec(name="ops", rate_limit_per_min=2.0),
+        ])
+        for i, src in enumerate(("a", "b", "c")):
+            notes = router.handle(_alert(source=src, ts=10.0 + i))
+            assert len(notes) == 1
+        statuses = [
+            n["status"]
+            for n in read_notifications(tmp_path / "notifications.jsonl")
+        ]
+        assert statuses == ["sent", "sent", "silenced"]
+        # a minute later the budget refills
+        notes = router.handle(_alert(source="d", ts=200.0))
+        assert notes[0]["status"] == "sent"
+        router.close()
+
+    def test_stderr_sink(self, tmp_path, capsys):
+        router = _router(tmp_path, [RouteSpec(name="term",
+                                              sink="stderr")])
+        router.handle(_alert())
+        assert "staleness:r0:" in capsys.readouterr().err
+        router.close()
+
+    def test_handle_never_raises(self, tmp_path, capsys):
+        router = _router(tmp_path, [RouteSpec(name="ops")])
+        assert router.handle(None) == []  # not even on garbage
+        assert "dropped alert" in capsys.readouterr().err
+        router.close()
+
+
+class TestWebhook:
+    def test_post_delivers_alert_body(self, tmp_path, receiver):
+        router = _router(tmp_path, [
+            RouteSpec(name="hook", sink="webhook", url=receiver.url),
+        ])
+        notes = router.handle(_alert())
+        assert notes[0]["status"] == "sent"
+        body = json.loads(receiver.bodies[0])
+        assert body["fingerprint"] == "staleness:r0:"
+        assert body["severity"] == "critical"
+        assert body["alert"]["state"] == "stale"
+        router.close()
+
+    def test_retry_honors_env_and_recovers(self, tmp_path, receiver,
+                                           monkeypatch):
+        monkeypatch.setenv("PROGEN_RETRY_ATTEMPTS", "3")
+        monkeypatch.setenv("PROGEN_RETRY_BASE_S", "0.01")
+        monkeypatch.setenv("PROGEN_RETRY_MAX_S", "0.02")
+        receiver.fail_next = 2  # two 503s, then accept
+        router = _router(tmp_path, [
+            RouteSpec(name="hook", sink="webhook", url=receiver.url),
+        ])
+        notes = router.handle(_alert())
+        assert notes[0]["status"] == "sent"
+        assert len(receiver.bodies) == 1
+        router.close()
+
+    def test_attempts_budget_exhausted_is_failed(self, tmp_path,
+                                                 receiver, monkeypatch):
+        monkeypatch.setenv("PROGEN_RETRY_ATTEMPTS", "2")
+        monkeypatch.setenv("PROGEN_RETRY_BASE_S", "0.01")
+        monkeypatch.setenv("PROGEN_RETRY_MAX_S", "0.02")
+        receiver.fail_next = 5
+        router = _router(tmp_path, [
+            RouteSpec(name="hook", sink="webhook", url=receiver.url),
+        ])
+        notes = router.handle(_alert())
+        assert notes[0]["status"] == "failed"
+        assert notes[0]["reason"]
+        assert receiver.fail_next == 3  # exactly 2 attempts spent
+        router.close()
+
+
+class TestRestartReload:
+    def test_ledger_reload_keeps_dedup(self, tmp_path):
+        router = _router(tmp_path, [RouteSpec(name="ops")])
+        router.handle(_alert(ts=1.0))
+        router.close()
+        # a NEW router over the same ledger: the repeat stays deduped
+        router2 = _router(tmp_path, [RouteSpec(name="ops")])
+        notes = router2.handle(_alert(ts=2.0))
+        assert [n["status"] for n in notes] == ["deduped"]
+        assert router2.counts["sent"] == 1  # reloaded history counts
+        router2.close()
+
+    def test_ledger_reload_keeps_silence(self, tmp_path):
+        routes = [RouteSpec(name="quiet", silence_s=100.0)]
+        router = _router(tmp_path, routes)
+        router.handle(_alert(ts=10.0))
+        router.close()
+        router2 = _router(tmp_path, routes)
+        notes = router2.handle(_alert(ts=20.0, state="fresh"))
+        assert [n["status"] for n in notes] == ["silenced"]
+        router2.close()
+
+
+class TestAlertSinkPersistence:
+    def test_no_refire_after_restart(self, tmp_path):
+        sink = AlertSink(tmp_path / "alerts.jsonl")
+        assert sink.staleness("r0", up=False, age_s=30.0,
+                              now=1.0) is not None
+        sink.close()
+        # restart: same path, state reloaded from disk
+        sink2 = AlertSink(tmp_path / "alerts.jsonl")
+        assert sink2.last_state("staleness", "r0") == "stale"
+        assert sink2.staleness("r0", up=False, age_s=60.0,
+                               now=2.0) is None
+        assert sink2.suppressed == 1
+        # the RECOVERY edge still fires
+        assert sink2.staleness("r0", up=True, age_s=0.0,
+                               now=3.0) is not None
+        sink2.close()
+        lines = [
+            json.loads(ln) for ln in
+            (tmp_path / "alerts.jsonl").read_text().splitlines()
+        ]
+        assert [r["state"] for r in lines] == ["stale", "fresh"]
+
+    def test_slo_state_persists(self, tmp_path):
+        sink = AlertSink(tmp_path / "alerts.jsonl")
+        sink.slo_transition({"objective": "ttft_p95",
+                             "state": "burning", "ts": 1.0})
+        sink.close()
+        sink2 = AlertSink(tmp_path / "alerts.jsonl")
+        assert sink2.last_states("slo_burn") == {"ttft_p95": "burning"}
+        assert sink2.slo_transition(
+            {"objective": "ttft_p95", "state": "burning", "ts": 2.0}
+        ) is None
+        assert sink2.slo_transition(
+            {"objective": "ttft_p95", "state": "resolved", "ts": 3.0}
+        ) is not None
+        sink2.close()
+
+    def test_relay_sees_only_deduped_stream(self, tmp_path):
+        seen = []
+        sink = AlertSink(tmp_path / "alerts.jsonl", relay=seen.append)
+        sink.staleness("r0", up=False, age_s=30.0, now=1.0)
+        sink.close()
+        sink2 = AlertSink(tmp_path / "alerts.jsonl", relay=seen.append)
+        sink2.staleness("r0", up=False, age_s=60.0, now=2.0)  # repeat
+        sink2.staleness("r0", up=True, age_s=0.0, now=3.0)
+        sink2.close()
+        assert [(r["state"]) for r in seen] == ["stale", "fresh"]

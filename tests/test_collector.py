@@ -713,3 +713,272 @@ class TestSloReportTsdb:
         (r,) = payload["results"]
         assert r["objective"] == "fleet_availability"
         assert r["state"] == "burning"
+
+
+class TestCollectorRestart:
+    """Satellite fix: a collector restart must not re-announce alert
+    states it already announced — the sink persists last-known states
+    beside alerts.jsonl and the collector seeds its edge detectors
+    from them on start."""
+
+    def _collector(self, tmp_path, prom):
+        db = RingTSDB(tmp_path / "tsdb")
+        sink = AlertSink(tmp_path / "alerts.jsonl")
+        coll = Collector(
+            db, [SourceSpec(name="r0", prom=str(prom))],
+            stale_after_s=10.0, alerts=sink,
+        )
+        return db, sink, coll
+
+    def _alert_states(self, tmp_path):
+        return [
+            json.loads(line)["state"]
+            for line in (tmp_path / "alerts.jsonl").read_text().splitlines()
+        ]
+
+    def test_restart_does_not_refire_identical_stale(self, tmp_path):
+        prom = _write_prom(
+            tmp_path / "r0.prom", _serving_metrics(), mtime=1000.0
+        )
+        db, sink, coll = self._collector(tmp_path, prom)
+        coll.scrape_once(now=1001.0)  # first observation
+        coll.scrape_once(now=1030.0)  # up -> down edge fires
+        sink.close()
+        db.close()
+        assert self._alert_states(tmp_path) == ["stale"]
+        # collector restart while the source is STILL stale: the
+        # persisted state makes the repeat a suppression, not an edge
+        db2, sink2, coll2 = self._collector(tmp_path, prom)
+        coll2.scrape_once(now=1060.0)
+        coll2.scrape_once(now=1061.0)
+        assert self._alert_states(tmp_path) == ["stale"]
+        assert sink2.suppressed == 0  # collector seeding: no emit at all
+        # recovery after the restart still fires the fresh edge
+        os.utime(prom, (1070.0, 1070.0))
+        coll2.scrape_once(now=1071.0)
+        assert self._alert_states(tmp_path) == ["stale", "fresh"]
+        sink2.close()
+        db2.close()
+
+    def test_restart_fires_edge_missed_while_down(self, tmp_path):
+        prom = _write_prom(
+            tmp_path / "r0.prom", _serving_metrics(), mtime=1000.0
+        )
+        db, sink, coll = self._collector(tmp_path, prom)
+        coll.scrape_once(now=1001.0)
+        coll.scrape_once(now=1030.0)  # stale fires
+        sink.close()
+        db.close()
+        # the source RECOVERS while the collector is down; the restarted
+        # collector's first scrape must fire the fresh edge it missed
+        os.utime(prom, (1050.0, 1050.0))
+        db2, sink2, coll2 = self._collector(tmp_path, prom)
+        coll2.scrape_once(now=1051.0)
+        assert self._alert_states(tmp_path) == ["stale", "fresh"]
+        sink2.close()
+        db2.close()
+
+    def test_slo_watch_seed_suppresses_reannounce(self, tmp_path):
+        from progen_tpu.telemetry.slo import SloResult, SloWatch
+
+        slo_toml = tmp_path / "slo.toml"
+        slo_toml.write_text(FLEET_SLO_TOML)
+        cfg = load_objectives(slo_toml)
+        out = []
+        watch = SloWatch(cfg, emit=out.append)
+        watch.seed("fleet_availability", "burning")
+        r = SloResult(
+            objective="fleet_availability", kind="availability",
+            state="burning", burn_short=3.0, burn_long=3.0, value=1.0,
+        )
+        watch.observe([r], now=1.0)
+        assert out == []  # still burning: no re-announcement
+        r_ok = SloResult(
+            objective="fleet_availability", kind="availability",
+            state="ok", burn_short=0.0, burn_long=0.0, value=2.0,
+        )
+        watch.observe([r_ok], now=2.0)
+        assert [rec["state"] for rec in out] == ["resolved"]
+        # a persisted "resolved" seeds back to ok
+        watch2 = SloWatch(cfg, emit=out.append)
+        watch2.seed("fleet_availability", "resolved")
+        watch2.observe([r_ok], now=3.0)
+        assert len(out) == 1
+
+
+class TestConsoleNotifications:
+    def _store_with_router(self, tmp_path):
+        from progen_tpu.telemetry.alert_router import (
+            AlertRouter, RouteSpec,
+        )
+
+        db = RingTSDB(tmp_path / "tsdb")
+        db.append(_sample(
+            1.0, "r0", counters={"requests_completed": 10},
+        ))
+        router = AlertRouter(
+            tmp_path / "tsdb" / "notifications.jsonl",
+            [RouteSpec(name="ops"),
+             RouteSpec(name="quiet", silence_s=100.0)],
+        )
+        sink = AlertSink(
+            tmp_path / "tsdb" / "alerts.jsonl", relay=router.handle
+        )
+        sink.staleness("r0", up=False, age_s=30.0, now=2.0)
+        sink.staleness("r0", up=True, age_s=0.0, now=3.0)
+        sink.close()
+        router.close()
+        return db
+
+    def test_snapshot_counts_and_tail(self, tmp_path):
+        from progen_tpu.telemetry.console import build_snapshot
+
+        db = self._store_with_router(tmp_path)
+        snap = build_snapshot(
+            db,
+            alerts_path=tmp_path / "tsdb" / "alerts.jsonl",
+            notifications_path=tmp_path / "tsdb" / "notifications.jsonl",
+        )
+        counts = snap["notify_counts"]
+        # edge 1 delivered on both routes; edge 2 delivered on "ops"
+        # but silenced on "quiet" (inside its 100s window)
+        assert counts["sent"] == 3
+        assert counts["silenced"] == 1
+        assert counts["deduped"] == 0
+        assert counts["routed"] == counts["sent"] + counts["failed"]
+        assert snap["notifications"][-1]["status"] in (
+            "sent", "silenced"
+        )
+
+    def test_snapshot_keys_present_without_ledger(self, tmp_path):
+        from progen_tpu.telemetry.console import build_snapshot
+
+        db = RingTSDB(tmp_path / "tsdb")
+        db.append(_sample(1.0, "r0"))
+        snap = build_snapshot(db)
+        assert snap["notifications"] == []
+        assert snap["notify_counts"]["routed"] == 0
+        db.close()
+
+    def test_alerts_only_render(self, tmp_path):
+        from progen_tpu.telemetry.console import build_snapshot, render
+
+        db = self._store_with_router(tmp_path)
+        snap = build_snapshot(
+            db,
+            alerts_path=tmp_path / "tsdb" / "alerts.jsonl",
+            notifications_path=tmp_path / "tsdb" / "notifications.jsonl",
+        )
+        text = render(snap, color=False, alerts_only=True)
+        assert "notifications" in text and "recent alerts" in text
+        assert "SOURCE" not in text  # the fleet table is dropped
+        full = render(snap, color=False)
+        assert "SOURCE" in full
+        # the alert tail shows delivery state inline
+        assert "[sent" in full
+
+
+class TestEgressCli:
+    def test_collector_all_egress_flags(self, tmp_path):
+        """One collector run with --remote-write + --alert-config +
+        --archive: series reach the receiver, the staleness edge routes
+        to the ledger, sealed blocks ship with valid digests."""
+        import time as _t
+
+        from tests.test_remote_write import _Receiver
+
+        from progen_tpu.cli.collector import main as collector_cli
+        from progen_tpu.telemetry.remote_write import payload_to_prom_text
+        from progen_tpu.telemetry.tsdb import verify_archive
+
+        prom = _write_prom(
+            tmp_path / "r0.prom", _serving_metrics(), mtime=_t.time()
+        )
+        router_toml = tmp_path / "router.toml"
+        router_toml.write_text('[route_ledger]\nsink = "file"\n')
+        receiver = _Receiver()
+        try:
+            res = CliRunner().invoke(collector_cli, [
+                "--tsdb", str(tmp_path / "tsdb"),
+                "--source", f"name=r0,prom={prom}",
+                "--interval", "0.9", "--stale-after", "0.4",
+                "--max-ticks", "2",
+                "--block-bytes", "64", "--budget-bytes", "128",
+                "--remote-write", receiver.url,
+                "--alert-config", str(router_toml),
+                "--archive", str(tmp_path / "archive"),
+            ])
+            assert res.exit_code == 0, res.output
+            # remote write: the fleet point decodes to the scraped totals
+            assert receiver.bodies
+            payload = json.loads(receiver.bodies[0])
+            back = parse_prom_text(payload_to_prom_text(payload))
+            assert back["requests_completed"] == 10.0
+            # alert routing: tick 1 fresh, tick 2 (0.9s later, past the
+            # 0.4s staleness bar) fires the down edge -> one sent record
+            notes = [
+                json.loads(line) for line in
+                (tmp_path / "tsdb" / "notifications.jsonl")
+                .read_text().splitlines()
+            ]
+            sent = [n for n in notes if n["status"] == "sent"]
+            assert len(sent) == 1
+            assert sent[0]["kind"] == "staleness"
+            assert sent[0]["route"] == "ledger"
+            # sink state persisted beside the alerts ledger
+            assert (tmp_path / "tsdb" / "alerts.state.json").exists()
+            # archive tiering: tiny block/budget forced shipping, and
+            # every archived block verifies against its manifest
+            checks = verify_archive(tmp_path / "archive")
+            assert checks and all(checks.values())
+            assert (tmp_path / "tsdb" / "archive.json").exists()
+        finally:
+            receiver.close()
+
+    def _routed_store(self, tmp_path):
+        from progen_tpu.telemetry.alert_router import (
+            AlertRouter, RouteSpec,
+        )
+
+        db = RingTSDB(tmp_path / "tsdb")
+        db.append(_sample(1.0, "r0",
+                          counters={"requests_completed": 10}))
+        db.close()
+        router = AlertRouter(
+            tmp_path / "tsdb" / "notifications.jsonl",
+            [RouteSpec(name="ops")],
+        )
+        sink = AlertSink(
+            tmp_path / "tsdb" / "alerts.jsonl", relay=router.handle
+        )
+        sink.staleness("r0", up=False, age_s=30.0, now=2.0)
+        sink.close()
+        router.close()
+        return tmp_path / "tsdb"
+
+    def test_top_once_json_includes_notify_counts(self, tmp_path):
+        from progen_tpu.cli.top import main as top_cli
+
+        store = self._routed_store(tmp_path)
+        # the ledger is discovered at the default path, no flag needed
+        res = CliRunner().invoke(
+            top_cli, ["--tsdb", str(store), "--once", "--json"]
+        )
+        assert res.exit_code == 0, res.output
+        snap = json.loads(res.output)
+        assert snap["notify_counts"]["sent"] == 1
+        assert snap["notify_counts"]["routed"] == 1
+        assert snap["notifications"][0]["route"] == "ops"
+
+    def test_top_alerts_only_mode(self, tmp_path):
+        from progen_tpu.cli.top import main as top_cli
+
+        store = self._routed_store(tmp_path)
+        res = CliRunner().invoke(top_cli, [
+            "--tsdb", str(store), "--once", "--alerts-only",
+            "--no-color",
+        ])
+        assert res.exit_code == 0, res.output
+        assert "notifications" in res.output
+        assert "recent alerts" in res.output
+        assert "SOURCE" not in res.output

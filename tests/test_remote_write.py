@@ -1,0 +1,244 @@
+"""Remote-write bridge (telemetry/remote_write.py): payload encoding
+round-trips through parse_prom_text, the bounded spool drops oldest
+under overflow, and push failure injection (endpoint down at start,
+mid-run 5xx with recovery) never blocks or raises — jax-free, with an
+in-process stdlib HTTP server as the fake receiver."""
+
+import json
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from progen_tpu.resilience.retry import RetryPolicy
+from progen_tpu.telemetry.remote_write import (
+    RemoteWriteBridge,
+    encode_point,
+    fleet_kinds,
+    merge_timeseries,
+    payload_to_prom_text,
+)
+from progen_tpu.telemetry.slo import parse_prom_text
+
+FLEET_VALS = {
+    "requests_completed": 40.0,
+    "decode_tokens": 900.0,
+    "queue_depth": 3.0,
+    "queue_depth_min": 1.0,
+    "queue_depth_sum": 4.0,
+    "fleet_up": 2.0,
+    "fleet_sources": 2.0,
+    "replicas_total": 2.0,
+    "replicas_live": 2.0,
+    "ttft_s_p50_s": 0.11,
+    "ttft_s_p95_s": 0.25,
+    "ttft_s_p99_s": 0.4,
+    "ttft_s_count": 12.0,
+    "ttft_s_sum": 1.8,
+    "ttft_s_mean_s": 0.15,
+}
+COUNTERS = {"requests_completed", "decode_tokens"}
+TIMINGS = {"ttft_s"}
+
+
+class _Receiver:
+    """In-process fake remote-write/webhook receiver: records every
+    POST body; ``fail_next`` responds 503 that many times first."""
+
+    def __init__(self):
+        self.bodies = []
+        self.paths = []
+        self.fail_next = 0
+        self.lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n)
+                with outer.lock:
+                    if outer.fail_next > 0:
+                        outer.fail_next -= 1
+                        self.send_response(503)
+                        self.end_headers()
+                        return
+                    outer.bodies.append(body)
+                    outer.paths.append(self.path)
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):  # keep pytest output clean
+                pass
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+        self.thread.start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.port}/write"
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture()
+def receiver():
+    r = _Receiver()
+    yield r
+    r.close()
+
+
+def _fast_policy():
+    return RetryPolicy(
+        max_attempts=3, base_delay_s=0.01, max_delay_s=0.05, jitter=0.0
+    )
+
+
+class TestEncoding:
+    def test_roundtrip_parse_equality(self):
+        """The encoded payload, rendered as exposition text and parsed
+        by parse_prom_text, equals the original fleet point (minus the
+        derivable mean)."""
+        point = encode_point(100.0, FLEET_VALS, COUNTERS, TIMINGS)
+        payload = {"timeseries": merge_timeseries([point])}
+        back = parse_prom_text(payload_to_prom_text(payload))
+        expect = {
+            k: v for k, v in FLEET_VALS.items() if k != "ttft_s_mean_s"
+        }
+        assert back == expect
+
+    def test_naming_conventions(self):
+        point = encode_point(100.0, FLEET_VALS, COUNTERS, TIMINGS)
+        names = {
+            (e["labels"]["__name__"], e["labels"].get("quantile"))
+            for e in point
+        }
+        assert ("progen_requests_completed_total", None) in names
+        assert ("progen_queue_depth", None) in names
+        assert ("progen_ttft_seconds", "0.95") in names
+        assert ("progen_ttft_seconds_sum", None) in names
+        assert ("progen_ttft_seconds_count", None) in names
+        # the derivable mean is not exported
+        assert not any("mean" in n for n, _ in names)
+
+    def test_timestamps_are_millis(self):
+        point = encode_point(123.456, {"queue_depth": 1.0}, set(), set())
+        assert point[0]["samples"][0][0] == 123456
+
+    def test_fleet_kinds_union_over_window(self):
+        window = [
+            {"counters": {"a": 1}, "timings": {"ttft_s": {}}},
+            {"counters": {"b": 2}, "timings": {}},
+            {"counters": {}, "timings": None},
+        ]
+        counters, timings = fleet_kinds(window)
+        assert counters == {"a", "b"} and timings == {"ttft_s"}
+
+    def test_batch_merges_same_series_in_time_order(self):
+        p1 = encode_point(2.0, {"queue_depth": 5.0}, set(), set())
+        p2 = encode_point(1.0, {"queue_depth": 3.0}, set(), set())
+        merged = merge_timeseries([p1, p2])
+        assert len(merged) == 1
+        assert merged[0]["samples"] == [[1000, 3.0], [2000, 5.0]]
+
+
+class TestPush:
+    def test_send_and_receiver_decodes(self, receiver):
+        bridge = RemoteWriteBridge(
+            receiver.url, policy=_fast_policy()
+        )
+        bridge.offer(100.0, FLEET_VALS, COUNTERS, TIMINGS)
+        assert bridge.flush(now=0.0) == "sent"
+        assert bridge.stats()["sent_points"] == 1
+        assert bridge.spooled() == 0
+        payload = json.loads(receiver.bodies[0])
+        back = parse_prom_text(payload_to_prom_text(payload))
+        assert back["requests_completed"] == 40.0
+        assert back["ttft_s_p95_s"] == 0.25
+
+    def test_endpoint_down_at_start_then_recovery(self):
+        # reserve a port with no listener: connection refused
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+        probe.close()
+        bridge = RemoteWriteBridge(
+            f"http://127.0.0.1:{port}/write", policy=_fast_policy(),
+            timeout_s=2.0,
+        )
+        bridge.offer(1.0, {"queue_depth": 1.0}, set(), set())
+        assert bridge.flush(now=0.0) == "failed"
+        assert bridge.stats()["push_failures"] == 1
+        assert bridge.spooled() == 1  # nothing lost, batch re-spooled
+        bridge.offer(2.0, {"queue_depth": 2.0}, set(), set())
+        receiver = _Receiver()
+        try:
+            bridge.url = receiver.url
+            # recovery after the backoff elapses: both points deliver
+            assert bridge.flush(now=1000.0) == "sent"
+            assert bridge.stats()["sent_points"] == 2
+            payload = json.loads(receiver.bodies[0])
+            samples = payload["timeseries"][0]["samples"]
+            assert [s[0] for s in samples] == [1000, 2000]
+        finally:
+            receiver.close()
+
+    def test_mid_run_5xx_backoff_then_recovery(self, receiver):
+        bridge = RemoteWriteBridge(
+            receiver.url, policy=_fast_policy()
+        )
+        bridge.offer(1.0, {"queue_depth": 1.0}, set(), set())
+        assert bridge.flush(now=0.0) == "sent"
+        receiver.fail_next = 1
+        bridge.offer(2.0, {"queue_depth": 2.0}, set(), set())
+        assert bridge.flush(now=1.0) == "failed"
+        # scrape-loop contract: inside the backoff window no HTTP call
+        # happens at all — the loop stays non-blocking
+        assert bridge.flush(now=1.0) == "backoff"
+        assert bridge.flush(now=1000.0) == "sent"
+        assert bridge.stats()["push_failures"] == 1
+        assert bridge.stats()["sent_points"] == 2
+
+    def test_backoff_grows_with_consecutive_failures(self):
+        bridge = RemoteWriteBridge(
+            "http://127.0.0.1:1/write",
+            policy=RetryPolicy(
+                max_attempts=4, base_delay_s=1.0, max_delay_s=60.0,
+                jitter=0.0,
+            ),
+            timeout_s=0.5,
+        )
+        bridge.offer(1.0, {"queue_depth": 1.0}, set(), set())
+        delays = []
+        now = 0.0
+        for _ in range(3):
+            assert bridge.flush(now=now) == "failed"
+            delays.append(bridge._next_due - now)
+            now = bridge._next_due
+        assert delays[0] < delays[1] < delays[2]
+
+    def test_spool_overflow_drops_oldest_with_counter(self):
+        bridge = RemoteWriteBridge(
+            "http://127.0.0.1:1/write", spool_points=3,
+            policy=_fast_policy(), timeout_s=0.5,
+        )
+        for i in range(5):
+            bridge.offer(float(i), {"queue_depth": float(i)},
+                         set(), set())
+        assert bridge.spooled() == 3
+        assert bridge.stats()["dropped_points"] == 2
+        # the survivors are the NEWEST three
+        kept = [p[0]["samples"][0][0] for p in bridge._spool]
+        assert kept == [2000, 3000, 4000]
+
+    def test_offer_never_raises_on_garbage(self):
+        bridge = RemoteWriteBridge("http://127.0.0.1:1/write")
+        bridge.offer(1.0, {"queue_depth": object()}, set(), set())
+        assert bridge.spooled() == 0
+        assert "encode" in bridge.last_error

@@ -1,11 +1,19 @@
 """Ring-buffer TSDB (telemetry/tsdb.py): block rotation, the byte
 budget (downsample before drop), torn-tail recovery, merge_pair
-semantics, and the read-only TsdbReader the console and slo-report
-open against a live store."""
+semantics, the read-only TsdbReader the console and slo-report open
+against a live store, and retention tiering (BlockShipper archives
+sealed blocks verbatim with a digest manifest before the ring degrades
+them; the reader replays archive+ring as one continuous store)."""
 
 import json
 
-from progen_tpu.telemetry.tsdb import RingTSDB, TsdbReader, merge_pair
+from progen_tpu.telemetry.tsdb import (
+    BlockShipper,
+    RingTSDB,
+    TsdbReader,
+    merge_pair,
+    verify_archive,
+)
 from progen_tpu.telemetry.trace import LineDrops
 
 
@@ -168,7 +176,12 @@ class TestTsdbReader:
         rd = TsdbReader(root)
         assert [r["ts"] for r in rd.read()] == [r["ts"] for r in db.read()]
         assert rd.total_bytes() == db.total_bytes()
-        assert rd.blocks() == db.blocks()
+        # the reader adds the archived flag; with no archive it is 0
+        assert [
+            {k: v for k, v in b.items() if k != "archived"}
+            for b in rd.blocks()
+        ] == db.blocks()
+        assert all(b["archived"] == 0 for b in rd.blocks())
         db.close()
         # reader leaves a torn tail ON DISK (the writer owns recovery)
         path = rd._scan()[-1][2]
@@ -184,3 +197,125 @@ class TestTsdbReader:
         rd = TsdbReader(tmp_path / "never_created")
         assert list(rd.read()) == []
         assert rd.total_bytes() == 0 and rd.blocks() == []
+
+
+def _tiered_db(tmp_path, **kw):
+    shipper = BlockShipper(tmp_path / "archive")
+    db = RingTSDB(tmp_path / "tsdb", shipper=shipper, **kw)
+    return db, shipper
+
+
+class TestBlockShipper:
+    def test_ships_before_degrading_with_valid_digests(self, tmp_path):
+        db, shipper = _tiered_db(
+            tmp_path, budget_bytes=4096, block_bytes=1024, max_level=2
+        )
+        for i in range(600):
+            db.append(_rec(i, counters={"done": i}))
+        db.close()
+        assert shipper.shipped > 0
+        checks = verify_archive(tmp_path / "archive")
+        assert checks and all(checks.values())
+        # each ship decision is one ev:"ship" record in the archive
+        ship_log = (tmp_path / "archive" / "ship.jsonl").read_text()
+        ops = [json.loads(ln)["op"] for ln in ship_log.splitlines()]
+        assert ops.count("shipped") == shipper.shipped
+        assert all(
+            json.loads(ln)["ev"] == "ship"
+            for ln in ship_log.splitlines()
+        )
+
+    def test_reship_of_degraded_survivor_is_skipped(self, tmp_path):
+        root = tmp_path / "tsdb"
+        db, shipper = _tiered_db(tmp_path, block_bytes=1 << 20)
+        for i in range(10):
+            db.append(_rec(i))
+        seq, level, path = db._scan()[0]
+        assert shipper.ship(seq, level, path) == "shipped"
+        # the same block coming around after a downsample (higher
+        # level) adds nothing over the archived verbatim copy
+        db._downsample(seq, level, path)
+        seq2, level2, path2 = db._scan()[0]
+        assert (seq2, level2) == (seq, level + 1)
+        assert shipper.ship(seq2, level2, path2) == "skipped"
+        # ...but a BETTER copy (lower level) would ship
+        assert shipper.skipped == 1
+        db.close()
+
+    def test_tampered_archive_fails_verification(self, tmp_path):
+        db, shipper = _tiered_db(tmp_path, block_bytes=1 << 20)
+        for i in range(5):
+            db.append(_rec(i))
+        seq, level, path = db._scan()[0]
+        shipper.ship(seq, level, path)
+        db.close()
+        victim = tmp_path / "archive" / path.name
+        with victim.open("a") as f:
+            f.write("bitrot\n")
+        checks = verify_archive(tmp_path / "archive")
+        assert checks[path.name] is False
+
+    def test_ship_failure_never_raises(self, tmp_path):
+        db, shipper = _tiered_db(tmp_path, block_bytes=1 << 20)
+        db.append(_rec(0))
+        seq, level, path = db._scan()[0]
+        op = shipper.ship(seq, level, tmp_path / "no_such_block.jsonl")
+        assert op == "verify_failed"
+        assert shipper.verify_failed == 1
+        db.close()
+
+
+class TestRetentionSeam:
+    def test_reader_replays_beyond_ring_horizon(self, tmp_path):
+        """With a shipper attached, every record the ring degraded or
+        dropped is still readable through the archive — the union view
+        equals the full original stream."""
+        db, shipper = _tiered_db(
+            tmp_path, budget_bytes=4096, block_bytes=1024, max_level=1
+        )
+        want = [_rec(i, counters={"done": i}) for i in range(600)]
+        for rec in want:
+            db.append(rec)
+        db.close()
+        # the pointer file makes archive discovery automatic
+        rd = TsdbReader(tmp_path / "tsdb")
+        assert rd.archive == (tmp_path / "archive").resolve()
+        got = list(rd.read())
+        # sealed blocks replay verbatim from the archive; only the
+        # still-active final block (never sealed, never shipped) plus
+        # blocks the ring still holds at l0 come from the ring. Every
+        # original record must be present exactly once, in order.
+        assert [r["ts"] for r in got] == [r["ts"] for r in want]
+        assert all(r.get("n", 1) == 1 for r in got), \
+            "a downsampled ring block shadowed its verbatim archive copy"
+        # and the ring ALONE has lost history (proves the seam matters)
+        ring = list(RingTSDB(tmp_path / "tsdb").read())
+        assert len(ring) < len(want)
+
+    def test_archived_flag_and_no_duplicate_seqs(self, tmp_path):
+        db, shipper = _tiered_db(
+            tmp_path, budget_bytes=4096, block_bytes=1024, max_level=1
+        )
+        for i in range(600):
+            db.append(_rec(i))
+        db.close()
+        rd = TsdbReader(tmp_path / "tsdb")
+        blocks = rd.blocks()
+        seqs = [b["seq"] for b in blocks]
+        assert len(seqs) == len(set(seqs))
+        assert any(b["archived"] for b in blocks)
+        assert blocks[-1]["archived"] == 0  # active block is ring-only
+
+    def test_explicit_archive_beats_missing_pointer(self, tmp_path):
+        db, shipper = _tiered_db(tmp_path, block_bytes=256)
+        for i in range(30):
+            db.append(_rec(i))
+        seq, level, path = db._scan()[0]
+        shipper.ship(seq, level, path)
+        db.close()
+        (tmp_path / "tsdb" / "archive.json").unlink()
+        path.unlink()  # ring lost the block entirely
+        rd = TsdbReader(tmp_path / "tsdb", archive=tmp_path / "archive")
+        assert [r["ts"] for r in rd.read()][0] == 0.0
+        # without the pointer or the flag, that history is invisible
+        assert list(TsdbReader(tmp_path / "tsdb").read())[0]["ts"] > 0.0
