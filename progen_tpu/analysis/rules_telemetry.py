@@ -71,8 +71,9 @@ dashboard query then matches nothing. Three checks:
     carry the ``kind``/``state``/``source``/``objective`` fields the
     alert relay and the CI fleet-metrics smoke key on, and literal
     ``kind``/``state`` values must come from the
-    ``staleness``/``slo_burn`` and
-    ``stale``/``fresh``/``warn``/``burning``/``resolved`` alphabets.
+    ``staleness``/``slo_burn``/``deploy_rollback`` and
+    ``stale``/``fresh``/``warn``/``burning``/``resolved``/
+    ``rolled_back`` alphabets.
   * ``"ev": "scale"`` dict literals (autoscaler decisions) may only be
     built in ``fleet/autoscaler.py``, must carry ``action`` and
     ``reason`` (the CI autoscale smoke asserts an up AND a down were
@@ -88,14 +89,22 @@ dashboard query then matches nothing. Three checks:
     only be built in ``telemetry/alert_router.py`` — a notify record
     claims the dedup/silence/rate pipeline ran; a hand-rolled one
     forges a delivery the on-call never received. A literal ``status``
-    must come from the ``sent``/``failed``/``silenced``/``deduped``
-    delivery alphabet (the console counts and the CI egress smoke key
-    on exactly these).
+    must come from the ``sent``/``failed``/``silenced``/``deduped``/
+    ``escalated`` delivery alphabet (the console counts and the CI
+    egress smoke key on exactly these).
   * ``"ev": "ship"`` dict literals (TSDB retention-tier decisions) may
     only be built in ``telemetry/tsdb.py`` — a ship record is the
     shipper's proof a block's digest was verified into the archive
     manifest; a literal ``op`` must come from the ``shipped``/
     ``skipped``/``verify_failed`` alphabet.
+  * ``"ev": "deploy"`` dict literals (deployment decisions) may only
+    be built in ``progen_tpu/deploy/`` — the deploy ledger is the
+    controller's resume authority, and a hand-rolled record forges a
+    canary/promote/rollback decision the controller never made; a
+    literal ``op`` must come from the ``observed``/``canary``/
+    ``probe``/``promote``/``rollback``/``converged`` alphabet (the CI
+    deployment smoke and the kill-matrix convergence asserts key on
+    exactly these).
 """
 
 from __future__ import annotations
@@ -163,15 +172,19 @@ class TelemetryHygieneRule(Rule):
     # samples/alerts reach disk through the TSDB / AlertSink file, not
     # through emit() — an emit-only check would never see them
     _ALERT_FIELDS = ("kind", "state", "source", "objective")
-    _ALERT_KINDS = ("staleness", "slo_burn")
-    _ALERT_STATES = ("stale", "fresh", "warn", "burning", "resolved")
+    _ALERT_KINDS = ("staleness", "slo_burn", "deploy_rollback")
+    _ALERT_STATES = ("stale", "fresh", "warn", "burning", "resolved",
+                     "rolled_back")
     _SAMPLE_ROLES = ("replica", "router", "run")
     _SCALE_FIELDS = ("action", "reason")
     _SCALE_ACTIONS = ("up", "down", "hold")
     _DROP_REASONS = ("bad_magic", "bad_version", "bad_auth",
                      "oversized", "chaos", "idle_timeout")
-    _NOTIFY_STATUSES = ("sent", "failed", "silenced", "deduped")
+    _NOTIFY_STATUSES = ("sent", "failed", "silenced", "deduped",
+                        "escalated")
     _SHIP_OPS = ("shipped", "skipped", "verify_failed")
+    _DEPLOY_OPS = ("observed", "canary", "probe", "promote",
+                   "rollback", "converged")
 
     def visit_Dict(self, node: ast.Dict) -> None:
         self.generic_visit(node)
@@ -221,8 +234,9 @@ class TelemetryHygieneRule(Rule):
                 self._check_literal_member(
                     node, "kind", self._ALERT_KINDS,
                     "alert record 'kind'",
-                    "only staleness and slo_burn alerts exist; a new "
-                    "kind needs the grammar (and this rule) extended",
+                    "only staleness, slo_burn and deploy_rollback "
+                    "alerts exist; a new kind needs the grammar (and "
+                    "this rule) extended",
                 )
                 self._check_literal_member(
                     node, "state", self._ALERT_STATES,
@@ -292,7 +306,7 @@ class TelemetryHygieneRule(Rule):
                     "notify record 'status'",
                     "the console's delivery counts and the CI egress "
                     "smoke classify by exactly the "
-                    "sent/failed/silenced/deduped alphabet",
+                    "sent/failed/silenced/deduped/escalated alphabet",
                 )
             elif v.value == "ship":
                 if not self._in_module("telemetry/tsdb.py"):
@@ -309,6 +323,24 @@ class TelemetryHygieneRule(Rule):
                     "ship record 'op'",
                     "retention triage greps exactly the "
                     "shipped/skipped/verify_failed op set",
+                )
+            elif v.value == "deploy":
+                if "/deploy/" not in self.ctx.path.replace("\\", "/"):
+                    self.report(
+                        v,
+                        "raw deploy record built outside "
+                        "progen_tpu/deploy/ — the deploy ledger is the "
+                        "controller's resume authority; a hand-rolled "
+                        "record forges a canary/promote/rollback "
+                        "decision the controller never made; go "
+                        "through DeployLedger",
+                    )
+                self._check_literal_member(
+                    node, "op", self._DEPLOY_OPS,
+                    "deploy record 'op'",
+                    "the deployment smoke and the kill-matrix "
+                    "convergence asserts grep exactly the observed/"
+                    "canary/probe/promote/rollback/converged op set",
                 )
 
     def _check_span_name(self, node: ast.Call) -> None:

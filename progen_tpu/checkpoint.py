@@ -116,6 +116,35 @@ def verify_manifest(state_dir, manifest: Optional[dict]) -> bool:
     return True
 
 
+def checkpoint_digest(ckpt_dir) -> Optional[str]:
+    """Content identity of ONE checkpoint directory: sha256 over its
+    meta.json integrity manifest (the per-file digests, already paid at
+    save time — no re-hashing of array bytes). Two saves of identical
+    weights agree; any differing byte under ``state/`` disagrees. Falls
+    back to hashing the whole meta.json when the manifest was disabled
+    (PROGEN_CKPT_DIGEST=0); None when meta.json is absent/unreadable
+    (the save never completed)."""
+    meta_path = Path(ckpt_dir) / "meta.json"
+    try:
+        meta = json.loads(meta_path.read_text())
+    except (OSError, ValueError):
+        return None
+    payload = meta.get("integrity") or meta
+    return hashlib.sha256(
+        json.dumps(payload, sort_keys=True).encode("utf-8")
+    ).hexdigest()
+
+
+def digest_gauge(digest: Optional[str]) -> float:
+    """The first 48 bits of a hex digest as a float gauge (exact in the
+    52-bit float64 mantissa) — how a replica publishes its live
+    checkpoint identity through Prometheus exposition so the deploy
+    controller and the router can see fleet skew. -1.0 = unknown."""
+    if not digest:
+        return -1.0
+    return float(int(digest[:12], 16))
+
+
 class Package(NamedTuple):
     """What one checkpoint holds — reference schema, train.py:196-202,
     plus ``train_config``: optimizer-structure-affecting run settings
@@ -420,30 +449,53 @@ def get_checkpoint_fns(
     # following get_last hash the same bytes once, not twice
     _verified: set = set()
 
+    def _verify_candidate(cand) -> Optional[tuple]:
+        """(dir, meta) when ``cand``'s manifest verifies; None after
+        quarantining it otherwise."""
+        try:
+            meta = json.loads(
+                retry_call(
+                    _read_text,
+                    cand / "meta.json",
+                    label="ckpt/io/meta_read",
+                )
+            )
+        except (OSError, ValueError):
+            _quarantine(cand, "unreadable meta.json")
+            return None
+        if _verify_enabled() and cand.name not in _verified:
+            if not verify_manifest(cand / "state", meta.get("integrity")):
+                _quarantine(cand, "integrity manifest mismatch")
+                return None
+            _verified.add(cand.name)
+        return cand, meta
+
     def _select_last() -> Optional[tuple]:
         """Newest COMPLETE checkpoint whose integrity manifest verifies,
         walking backward through older ones and quarantining failures —
         the fallback chain replacing the old newest-or-crash behavior.
         Returns (dir, meta) or None."""
         for cand in reversed(_complete(_list())):
-            try:
-                meta = json.loads(
-                    retry_call(
-                        _read_text,
-                        cand / "meta.json",
-                        label="ckpt/io/meta_read",
-                    )
-                )
-            except (OSError, ValueError):
-                _quarantine(cand, "unreadable meta.json")
-                continue
-            if _verify_enabled() and cand.name not in _verified:
-                if not verify_manifest(cand / "state", meta.get("integrity")):
-                    _quarantine(cand, "integrity manifest mismatch")
-                    continue
-                _verified.add(cand.name)
-            return cand, meta
+            sel = _verify_candidate(cand)
+            if sel is not None:
+                return sel
         return None
+
+    def _select_pinned(at) -> Optional[tuple]:
+        """The SPECIFIC checkpoint ``at`` (a ``ckpt_<stamp>`` directory
+        name, or a path whose basename is one), verified. A pin never
+        falls back: when the target is missing, incomplete, or fails its
+        digest walk (quarantined), the answer is None — serving some
+        OTHER checkpoint under a pin would defeat the deploy
+        controller's canary isolation."""
+        name = os.path.basename(str(at).rstrip("/"))
+        for cand in _complete(_list()):
+            if cand.name == name:
+                return _verify_candidate(cand)
+        return None
+
+    def _select(at=None) -> Optional[tuple]:
+        return _select_last() if at is None else _select_pinned(at)
 
     def _get_last(abstract_state: Any = None) -> Optional[Package]:
         import jax
@@ -477,12 +529,15 @@ def get_checkpoint_fns(
         with telemetry.span("ckpt/restore"):
             return _get_last(abstract_state)
 
-    def _restore_params(abstract_params: Any = None) -> Optional[Package]:
+    def _restore_params(
+        abstract_params: Any = None, at=None
+    ) -> Optional[Package]:
         """Params-only restore for inference (sample CLI): skips the Adam
         moments — ~2/3 of the checkpoint bytes, which matters at 1.2B on a
         small sampling box. ``state`` in the returned Package is just the
-        params pytree."""
-        sel = _select_last()
+        params pytree. ``at`` pins the restore to one specific checkpoint
+        (no newest-walk, no fallback) — the hot-reload pin seam."""
+        sel = _select(at)
         if sel is None:
             return None
         last, meta = sel
@@ -545,20 +600,22 @@ def get_checkpoint_fns(
             path=str(last),
         )
 
-    def restore_params(abstract_params: Any = None) -> Optional[Package]:
+    def restore_params(
+        abstract_params: Any = None, at=None
+    ) -> Optional[Package]:
         with telemetry.span("ckpt/restore_params"):
-            return _restore_params(abstract_params)
+            return _restore_params(abstract_params, at=at)
 
     get_last.restore_params = restore_params
 
-    def peek_last() -> Optional[Package]:
+    def peek_last(at=None) -> Optional[Package]:
         """Metadata only (state=None) — decide model config / resume point
         without paying the array restore (train.py:94-100 reads only the
         config before building the model). Runs the same verify+fallback
         walk as get_last (cached, so the bytes hash once) — otherwise the
         model could be built from a config whose checkpoint get_last later
-        quarantines."""
-        sel = _select_last()
+        quarantines. ``at`` pins the peek to one specific checkpoint."""
+        sel = _select(at)
         if sel is None:
             return None
         last, meta = sel

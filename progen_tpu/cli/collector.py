@@ -207,6 +207,8 @@ def main(
     try:
         while not stop["flag"]:
             coll.scrape_once()
+            if router is not None:
+                router.tick()  # fire any due escalation chains
             ticks += 1
             if once or (max_ticks and ticks >= max_ticks):
                 break
@@ -230,7 +232,8 @@ def main(
         tail += (
             f", notify {router.counts['sent']} sent / "
             f"{router.counts['silenced']} silenced / "
-            f"{router.counts['deduped']} deduped"
+            f"{router.counts['deduped']} deduped / "
+            f"{router.counts['escalated']} escalated"
         )
     if shipper is not None:
         tail += (

@@ -95,6 +95,12 @@ import click
               help="--max-queue for spawned replicas")
 @click.option("--max-len", default=None, type=int,
               help="--max-len for spawned replicas")
+@click.option("--replica_reload_watch", default=0.0, type=float,
+              help="spawned replicas watch their checkpoint dir every N "
+                   "seconds (serve --reload_watch) and honor a "
+                   "FLEET_DIR/replica{i}/reload.pin control file "
+                   "(serve --reload_pin) — the deploy controller's "
+                   "per-replica seam (0 = off)")
 @click.option("--max-queue", default=256,
               help="router admission queue bound (shed reason "
                    "'router_queue_full' beyond it)")
@@ -126,8 +132,9 @@ import click
               help="serve progen_router_* metrics over HTTP on this "
                    "localhost port (0 = off)")
 def main(replica_specs, spawn, checkpoint_path, fleet_dir, respawn,
-         replica_max_slots, replica_max_queue, max_len, max_queue,
-         tenant_quota, heartbeat_timeout, socket_path, listen_tcp,
+         replica_max_slots, replica_max_queue, max_len,
+         replica_reload_watch, max_queue, tenant_quota,
+         heartbeat_timeout, socket_path, listen_tcp,
          autoscale_policy, autoscale_tsdb, metrics_every,
          prom_file, prom_port):
     from progen_tpu import telemetry
@@ -172,6 +179,11 @@ def main(replica_specs, spawn, checkpoint_path, fleet_dir, respawn,
         ]
         if max_len is not None:
             args += ["--max-len", str(max_len)]
+        if replica_reload_watch:
+            args += [
+                "--reload_watch", str(replica_reload_watch),
+                "--reload_pin", os.path.join(rdir, "reload.pin"),
+            ]
         if replay:
             args += ["--replay", rdir]
         log = open(os.path.join(rdir, "replica.log"), "ab")

@@ -218,7 +218,7 @@ def _events_to_lines(events, completions, starts):
 
 def _build(checkpoint_path, max_slots, max_len, max_queue,
            quantize_int8=False, journal=None, prefill_chunk=0,
-           prefix_cache_mb=0):
+           prefix_cache_mb=0, pin=None):
     import os.path
 
     from progen_tpu.checkpoint import get_checkpoint_fns
@@ -227,7 +227,21 @@ def _build(checkpoint_path, max_slots, max_len, max_queue,
     from progen_tpu.serving import PrefixCache, Scheduler, ServeEngine
 
     _, get_last, _ = get_checkpoint_fns(checkpoint_path)
-    pkg = get_last.restore_params()
+    pkg = None
+    if pin is not None:
+        # a pre-existing pin file names the checkpoint this replica must
+        # serve (a controller-managed fleet member rebooting mid-deploy);
+        # an unloadable pin falls back to newest — the replica must come
+        # up serving SOMETHING, and the ack tells the controller the pin
+        # was not honored
+        pkg = get_last.restore_params(at=pin)
+        if pkg is None:
+            print(
+                f"reload pin {pin}: not restorable, falling back to "
+                f"newest checkpoint", file=sys.stderr,
+            )
+    if pkg is None:
+        pkg = get_last.restore_params()
     if pkg is None:
         sys.exit(f"no checkpoints found at {checkpoint_path}")
     config = ProGenConfig.from_dict(pkg.model_config)
@@ -328,11 +342,19 @@ def _build(checkpoint_path, max_slots, max_len, max_queue,
               help="poll the checkpoint dir every N seconds and "
                    "hot-reload when a new complete checkpoint appears "
                    "(0 = off; SIGHUP always triggers a reload)")
+@click.option("--reload_pin", "reload_pin_path", default=None, type=str,
+              help="per-replica pin control file (reload.pin): when it "
+                   "names a checkpoint, the --reload_watch poll loads "
+                   "exactly that one (newest-wins suspended) and "
+                   "answers through FILE.ack; at startup a pinned "
+                   "checkpoint is restored directly. The deploy "
+                   "controller's canary/promote seam. Implies "
+                   "--reload_watch 2 when unset")
 def main(checkpoint_path, max_slots, max_queue, max_len, quantize_int8,
          prefill_chunk, prefix_cache_mb, top_k, temperature, top_p, seed,
          socket_path, tcp_hostport, idle_timeout, metrics_every,
          prom_file, prom_port, heartbeat, journal_dir, replay_dir,
-         reload_watch):
+         reload_watch, reload_pin_path):
     from progen_tpu import telemetry
     from progen_tpu.resilience.chaos import install_from_env
     from progen_tpu.telemetry import (
@@ -352,10 +374,20 @@ def main(checkpoint_path, max_slots, max_queue, max_len, quantize_int8,
         from progen_tpu.serving import RequestJournal
 
         journal = RequestJournal(os.path.join(journal_dir, "journal.jsonl"))
+    startup_pin = None
+    if reload_pin_path:
+        if not reload_watch:
+            reload_watch = 2.0  # a pin nobody polls is a dead letter
+        try:
+            with open(reload_pin_path) as f:
+                startup_pin = f.read().strip() or None
+        except OSError:
+            startup_pin = None
     sched, engine, ckpt_name = _build(
         checkpoint_path, max_slots, max_len, max_queue,
         quantize_int8=quantize_int8, journal=journal,
         prefill_chunk=prefill_chunk, prefix_cache_mb=prefix_cache_mb,
+        pin=startup_pin,
     )
     defaults = {
         "length": engine.max_len, "top_k": top_k,
@@ -379,6 +411,19 @@ def main(checkpoint_path, max_slots, max_queue, max_len, quantize_int8,
 
     hb = {"last": _time.monotonic()}
 
+    from progen_tpu.checkpoint import checkpoint_digest, digest_gauge
+
+    ckd = {"name": ckpt_name}
+
+    def _digest_of(name):
+        if not name:
+            return -1.0
+        return digest_gauge(checkpoint_digest(
+            os.path.join(checkpoint_path, name)
+        ))
+
+    ckd["gauge"] = _digest_of(ckpt_name)
+
     def publish(step=None):
         # compile counts ride the metrics: the router's kill-matrix
         # reads the survivor's prom file to prove handoff didn't trigger
@@ -389,6 +434,9 @@ def main(checkpoint_path, max_slots, max_queue, max_len, quantize_int8,
         sched.metrics.set_gauge(
             "decode_compile_count", engine.decode_compile_count()
         )
+        # live checkpoint identity (first 48 digest bits as a float):
+        # the deploy controller and the router read fleet skew from this
+        sched.metrics.set_gauge("checkpoint_digest", ckd["gauge"])
         sched.metrics.log_to(tracker, step=step)
         if prom_file:
             write_prometheus(prom_file, prometheus_text(sched.metrics))
@@ -417,8 +465,13 @@ def main(checkpoint_path, max_slots, max_queue, max_len, quantize_int8,
     from progen_tpu.serving import WeightReloader
 
     reloader = WeightReloader(
-        engine, checkpoint_path, metrics=sched.metrics, current=ckpt_name
+        engine, checkpoint_path, metrics=sched.metrics,
+        current=ckpt_name, pin_path=reload_pin_path,
     )
+    # answer a pre-existing pin file now: committed when _build restored
+    # it, rejected when it fell back — the controller must not wait on a
+    # pin this process already settled
+    reloader.note_startup_pin()
     reload_req = {"flag": False}
 
     def tick():
@@ -438,7 +491,9 @@ def main(checkpoint_path, max_slots, max_queue, max_len, quantize_int8,
             reloader.poll_watch(reload_watch)
         name = reloader.maybe_commit()
         if name is not None:
+            ckd["name"], ckd["gauge"] = name, _digest_of(name)
             print(f"reload: now serving {name}", file=sys.stderr)
+            publish()  # the digest gauge must not wait a metrics cadence
         elif reloader.last_error is not None:
             print(f"reload: rejected ({reloader.last_error}) — still "
                   f"serving {reloader.current}", file=sys.stderr)

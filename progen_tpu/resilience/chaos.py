@@ -103,6 +103,7 @@ from progen_tpu.resilience.retry import TransientError
 KNOWN_TARGETS = frozenset({
     # spans
     "ckpt/finalize", "ckpt/restore", "ckpt/restore_params", "ckpt/save",
+    "deploy/canary", "deploy/probe", "deploy/promote", "deploy/rollback",
     "router/handoff",
     "serve/prefill", "serve/prefill_chunk", "serve/reload",
     "serve/reload_commit",

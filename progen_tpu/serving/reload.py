@@ -13,11 +13,20 @@ part off-thread and leaves only an attribute rebind on the loop:
     lands back on the currently served checkpoint is REJECTED, not
     re-applied), then ``engine.prepare_params`` (layout transform,
     tree/shape/dtype compatibility check, int8 re-quant + calibration);
+    ``request_reload(path="ckpt_N")`` pins the load to that SPECIFIC
+    verified checkpoint instead of the newest — a pin that cannot be
+    verified (digest mismatch, missing dir) or prepared (incompatible
+    tree) is rejected and the current weights keep serving;
   * ``maybe_commit()`` — called by the serve loop between decode
     steps: applies a staged result atomically, or does nothing;
   * ``poll_watch()`` — optional checkpoint-dir watcher behind
     ``--reload_watch``: kicks a reload when a new complete checkpoint
-    appears.
+    appears. With a ``pin_path`` (``--reload_pin``), a non-empty
+    ``reload.pin`` control file OVERRIDES the newest-wins scan: the
+    poll reloads exactly the pinned name and answers through an
+    adjacent ``reload.pin.ack`` JSON file (``{"pin", "status",
+    "reason"}``) — the deploy controller's per-replica control seam.
+    Removing the pin file returns the replica to newest-wins watching.
 
 Every outcome is observable: ``serve/reload`` / ``serve/reload_commit``
 spans bracket the work (chaos-injectable kill points for the serve
@@ -29,6 +38,8 @@ summary. The ``reload`` record grammar lives HERE (linted by PGL006).
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 import time
 from pathlib import Path
@@ -45,7 +56,8 @@ class WeightReloader:
     corrupt newer one — are rejected as no-ops."""
 
     def __init__(self, engine: ServeEngine, checkpoint_path, *,
-                 metrics=None, current: Optional[str] = None):
+                 metrics=None, current: Optional[str] = None,
+                 pin_path=None):
         from progen_tpu.checkpoint import get_checkpoint_fns
 
         self.engine = engine
@@ -53,11 +65,17 @@ class WeightReloader:
         self._get_last = get_checkpoint_fns(self.checkpoint_path)[1]
         self.metrics = metrics
         self.current = current
+        self.pin_path = Path(pin_path) if pin_path else None
         self.last_error: Optional[str] = None
         self._lock = threading.Lock()
         self._staged: Optional[tuple] = None  # (name, prepared, load_s)
         self._thread: Optional[threading.Thread] = None
         self._watch_mark = 0.0
+        # the pin content whose load was rejected — retried only when
+        # the controller writes a DIFFERENT pin (no hot retry loop on a
+        # checkpoint that will keep failing its digest walk)
+        self._failed_pin: Optional[str] = None
+        self._acked: Optional[tuple] = None  # (pin, status) last written
         if metrics is not None:
             # families exist (at zero) from construction so the
             # Prometheus exposition is stable before the first reload
@@ -67,14 +85,17 @@ class WeightReloader:
 
     # ----- background load ------------------------------------------------
 
-    def request_reload(self) -> bool:
-        """Kick a background load of the newest verified checkpoint.
+    def request_reload(self, path: Optional[str] = None) -> bool:
+        """Kick a background load — of the newest verified checkpoint,
+        or (``path=``) of one SPECIFIC checkpoint name/path through the
+        same digest-verify chain, with no fallback to anything else.
         False when one is already in flight (SIGHUP storms coalesce)."""
         with self._lock:
             if self._thread is not None and self._thread.is_alive():
                 return False
             self._thread = threading.Thread(
-                target=self._load, name="weight-reload", daemon=True
+                target=self._load, name="weight-reload", daemon=True,
+                args=(path,),
             )
             self._thread.start()
             return True
@@ -85,7 +106,7 @@ class WeightReloader:
         if t is not None:
             t.join(timeout)
 
-    def _reject(self, reason: str) -> None:
+    def _reject(self, reason: str, pin: Optional[str] = None) -> None:
         self.last_error = reason
         if self.metrics is not None:
             self.metrics.inc("reload_rejected")
@@ -93,27 +114,35 @@ class WeightReloader:
             "ev": "reload", "ts": time.time(), "status": "rejected",
             "reason": reason,
         })
+        if pin is not None:
+            self._failed_pin = pin
+            self._write_ack(pin, "rejected", reason)
 
-    def _load(self) -> None:
+    def _load(self, path: Optional[str] = None) -> None:
         """Runs on the background thread. Current weights keep serving
         no matter what happens here — nothing touches the engine until
         ``maybe_commit`` on the loop thread."""
+        pin = Path(path).name if path is not None else None
         t0 = time.perf_counter()
         try:
             with span("serve/reload"):
-                pkg = self._get_last.restore_params()
+                pkg = self._get_last.restore_params(at=path)
                 if pkg is None:
-                    self._reject("no_checkpoint")
+                    self._reject(
+                        "pin_unavailable" if pin else "no_checkpoint",
+                        pin=pin,
+                    )
                     return
                 name = Path(pkg.path).name if pkg.path else None
-                if name is not None and name == self.current:
+                if pin is None and name is not None \
+                        and name == self.current:
                     # the verify walk landed on what we already serve
                     # (nothing newer, or the newer one was quarantined)
                     self._reject("no_new_checkpoint")
                     return
                 prepared = self.engine.prepare_params(pkg.state)
         except Exception as e:  # incompat, I/O, injected chaos — reject
-            self._reject(f"{type(e).__name__}: {e}")
+            self._reject(f"{type(e).__name__}: {e}", pin=pin)
             return
         with self._lock:
             self._staged = (name, prepared, time.perf_counter() - t0)
@@ -148,21 +177,87 @@ class WeightReloader:
             "ev": "reload", "ts": time.time(), "status": "committed",
             "ckpt": name, "duration_s": round(total, 6),
         })
+        if name is not None and name == self.read_pin():
+            self._failed_pin = None
+            self._write_ack(name, "committed")
         return name
+
+    # ----- pin control file --------------------------------------------------
+
+    def read_pin(self) -> Optional[str]:
+        """The pinned checkpoint name, or None (no pin file / empty)."""
+        if self.pin_path is None:
+            return None
+        try:
+            content = self.pin_path.read_text().strip()
+        except OSError:
+            return None
+        return content or None
+
+    def _write_ack(self, pin: str, status: str, reason: str = "") -> None:
+        """Atomic ``reload.pin.ack`` rewrite — the controller's read of
+        a pin's outcome (its own prom scrape can lag the commit)."""
+        if self.pin_path is None or self._acked == (pin, status):
+            return
+        rec = {"pin": pin, "status": status, "ts": time.time()}
+        if reason:
+            rec["reason"] = reason
+        ack = self.pin_path.with_name(self.pin_path.name + ".ack")
+        tmp = ack.with_name(ack.name + ".tmp")
+        try:
+            tmp.write_text(json.dumps(rec))
+            os.replace(tmp, ack)
+        except OSError:
+            return
+        self._acked = (pin, status)
+
+    def ack_current(self) -> None:
+        """Confirm an already-satisfied pin (startup restored it, or the
+        controller re-wrote the name we serve): ack without reloading."""
+        pin = self.read_pin()
+        if pin is not None and pin == self.current:
+            self._failed_pin = None
+            self._write_ack(pin, "committed")
+
+    def note_startup_pin(self) -> None:
+        """Answer a pin file that predates this process: committed when
+        startup restored exactly the pinned checkpoint, rejected when
+        ``_build`` had to fall back to another one (so the controller
+        is not left waiting on an ack that will never arrive)."""
+        pin = self.read_pin()
+        if pin is None:
+            return
+        if pin == self.current:
+            self._failed_pin = None
+            self._write_ack(pin, "committed")
+        else:
+            self._failed_pin = pin
+            self._write_ack(pin, "rejected", "pin_unavailable_at_startup")
 
     # ----- checkpoint-dir watcher -------------------------------------------
 
     def poll_watch(self, interval_s: float = 2.0) -> bool:
-        """Throttled directory scan: when a complete checkpoint newer
-        than ``current`` exists and nothing is in flight or staged,
-        kick a reload. Returns True when one was kicked."""
+        """Throttled directory scan. A non-empty pin file overrides the
+        newest-wins walk: reload exactly the pinned name (once per pin
+        content — a rejected pin is not retried until it changes). With
+        no pin: when a complete checkpoint newer than ``current`` exists
+        and nothing is in flight or staged, kick a reload. Returns True
+        when one was kicked."""
         now = time.monotonic()
         if now - self._watch_mark < interval_s:
             return False
         self._watch_mark = now
-        newest = self._newest_complete()
-        if newest is None or newest == self.current:
-            return False
+        pin = self.read_pin()
+        if pin is not None:
+            if pin == self.current:
+                self.ack_current()
+                return False
+            if pin == self._failed_pin:
+                return False
+        else:
+            newest = self._newest_complete()
+            if newest is None or newest == self.current:
+                return False
         with self._lock:
             busy = (
                 self._staged is not None
@@ -170,7 +265,7 @@ class WeightReloader:
             )
         if busy:
             return False
-        return self.request_reload()
+        return self.request_reload(path=pin)
 
     def _newest_complete(self) -> Optional[str]:
         from progen_tpu.checkpoint import _CKPT_NAME_RE

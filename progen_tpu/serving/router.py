@@ -546,7 +546,7 @@ class Router:
             # fleet collector sees per-replica pressure through the
             # router's exposition even when replica files are remote
             for key in ("queue_depth", "slot_occupancy",
-                        "decode_compile_count"):
+                        "decode_compile_count", "checkpoint_digest"):
                 if key in link.health:
                     self.metrics.set_gauge(
                         f"replica{link.index}_{key}", link.health[key]

@@ -24,13 +24,25 @@ pipeline, per alert:
      honored), ``stderr`` (one line for a terminal operator), or
      ``file`` (the ledger itself is the delivery).
 
-Every routing decision — sent, failed, silenced, deduped — lands as
-one ``ev:"notify"`` record in ``notifications.jsonl`` (the ledger the
-console tails and CI asserts on). PGL006 enforces the grammar: notify
-records are built only here, status from the sent/failed/silenced/
-deduped alphabet. On construction the router replays its own ledger to
-rebuild dedup + silence state, so a restarted collector does not
-re-deliver what was already delivered.
+**Escalation chains**: ``[route_X] escalate_to = "Y",
+escalate_after_s = N`` — a warning/critical alert delivered through X
+that is still in the same state after N seconds (nothing resolved or
+changed it) re-fires through route Y, bypassing Y's kind/severity
+gates, recorded with ``status:"escalated"`` and reason
+``escalated_from:X``. The owning loop drives this by calling
+``tick()``; pending escalations are rebuilt from the ledger on
+restart (armed by the original ``sent`` record, disarmed by a later
+state change or by the escalation's own record), so the re-fire
+happens exactly once across router restarts. Chains do not cascade:
+an escalated delivery does not arm Y's own ``escalate_to``.
+
+Every routing decision — sent, failed, silenced, deduped, escalated —
+lands as one ``ev:"notify"`` record in ``notifications.jsonl`` (the
+ledger the console tails and CI asserts on). PGL006 enforces the
+grammar: notify records are built only here, status from the
+sent/failed/silenced/deduped/escalated alphabet. On construction the
+router replays its own ledger to rebuild dedup + silence state, so a
+restarted collector does not re-deliver what was already delivered.
 """
 
 from __future__ import annotations
@@ -52,7 +64,7 @@ from progen_tpu.resilience.retry import (
 from progen_tpu.telemetry.spans import EventLog
 from progen_tpu.telemetry.trace import iter_jsonl
 
-NOTIFY_STATUSES = ("sent", "failed", "silenced", "deduped")
+NOTIFY_STATUSES = ("sent", "failed", "silenced", "deduped", "escalated")
 SEVERITIES = ("info", "warning", "critical")
 ROUTE_SINKS = ("webhook", "file", "stderr")
 
@@ -64,6 +76,7 @@ DEFAULT_SEVERITY = {
     "warn": "warning",
     "stale": "critical",
     "burning": "critical",
+    "rolled_back": "critical",
 }
 
 
@@ -96,6 +109,8 @@ class RouteSpec:
     silence_s: float = 0.0
     rate_limit_per_min: float = 0.0
     timeout_s: float = 5.0
+    escalate_to: str = ""  # re-fire through this route when unacked
+    escalate_after_s: float = 0.0
 
     def __post_init__(self):
         if self.sink not in ROUTE_SINKS:
@@ -111,6 +126,15 @@ class RouteSpec:
         if self.sink == "webhook" and not self.url:
             raise ValueError(
                 f"route {self.name!r}: webhook sink requires url"
+            )
+        if bool(self.escalate_to) != (self.escalate_after_s > 0):
+            raise ValueError(
+                f"route {self.name!r}: escalate_to and "
+                "escalate_after_s must be set together"
+            )
+        if self.escalate_to == self.name:
+            raise ValueError(
+                f"route {self.name!r}: cannot escalate to itself"
             )
 
     def kind_set(self) -> Tuple[str, ...]:
@@ -190,6 +214,13 @@ class AlertRouter:
     ):
         self.ledger_path = Path(ledger_path)
         self.routes = list(routes)
+        self._route_map = {r.name: r for r in self.routes}
+        for r in self.routes:
+            if r.escalate_to and r.escalate_to not in self._route_map:
+                raise ValueError(
+                    f"route {r.name!r}: escalate_to names unknown "
+                    f"route {r.escalate_to!r}"
+                )
         self.severity_map = dict(severity or DEFAULT_SEVERITY)
         self._opener = opener or urllib.request.urlopen
         self._policy = policy_from_env()
@@ -202,6 +233,10 @@ class AlertRouter:
         self._last_sent: Dict[Tuple[str, str], float] = {}
         # route -> recent delivery timestamps (rate limit)
         self._sent_times: Dict[str, List[float]] = {}
+        # (origin route, fingerprint) -> (deadline, alert) for armed
+        # escalations; disarmed by a state change on the fingerprint
+        # or by the escalation firing (tick)
+        self._pending: Dict[Tuple[str, str], Tuple[float, dict]] = {}
         self.counts: Dict[str, int] = {s: 0 for s in NOTIFY_STATUSES}
         self._reload()
         self._ledger = EventLog(self.ledger_path)
@@ -225,11 +260,41 @@ class AlertRouter:
             if status in self.counts:
                 self.counts[status] += 1
             if status != "deduped":
-                self._last_state[fp] = str(rec.get("state", ""))
+                state = str(rec.get("state", ""))
+                if self._last_state.get(fp) != state:
+                    # a new edge acks everything armed on the old one
+                    self._disarm(fp)
+                self._last_state[fp] = state
             if status == "sent":
                 route = str(rec.get("route", ""))
                 self._last_sent[(route, fp)] = ts
                 self._sent_times.setdefault(route, []).append(ts)
+                spec = self._route_map.get(route)
+                if spec is not None and spec.escalate_to:
+                    sev = str(rec.get("severity", ""))
+                    if _severity_rank(sev) >= _severity_rank("warning"):
+                        # alert payload reconstructed from the notify
+                        # record (not a new ev:"alert" — the original
+                        # already fired; this is re-delivery material)
+                        self._pending[(route, fp)] = (
+                            ts + spec.escalate_after_s,
+                            {
+                                "ts": ts,
+                                "kind": rec.get("kind", ""),
+                                "state": rec.get("state", ""),
+                                "source": rec.get("source", ""),
+                                "objective": rec.get("objective", ""),
+                            },
+                        )
+            reason = str(rec.get("reason", ""))
+            if reason.startswith("escalated_from:"):
+                # the escalation already fired (or terminally failed)
+                origin = reason.split(":", 1)[1].split()[0]
+                self._pending.pop((origin, fp), None)
+
+    def _disarm(self, fp: str) -> None:
+        for key in [k for k in self._pending if k[1] == fp]:
+            del self._pending[key]
 
     # -- pipeline ---------------------------------------------------------
 
@@ -256,6 +321,7 @@ class AlertRouter:
             return [self._note(alert, fp, sev, now, route="",
                                status="deduped", reason="repeat")]
         self._last_state[fp] = state
+        self._disarm(fp)  # the state edge acks any armed escalation
         out: List[dict] = []
         for route in self.routes:
             kinds = route.kind_set()
@@ -274,9 +340,51 @@ class AlertRouter:
             if ok:
                 self._last_sent[(route.name, fp)] = now
                 self._sent_times.setdefault(route.name, []).append(now)
+                if route.escalate_to and _severity_rank(sev) >= \
+                        _severity_rank("warning"):
+                    self._pending[(route.name, fp)] = (
+                        now + route.escalate_after_s, dict(alert)
+                    )
             out.append(self._note(alert, fp, sev, now,
                                   route=route.name, status=status,
                                   reason=detail))
+        return out
+
+    def tick(self, now: Optional[float] = None) -> List[dict]:
+        """Fire due escalations. The owning loop (the collector CLI)
+        calls this every iteration; it must never raise into it."""
+        try:
+            return self._tick(time.time() if now is None else float(now))
+        except Exception as exc:
+            print(
+                f"[alert-router] escalation tick failed: {exc}",
+                file=sys.stderr,
+            )
+            return []
+
+    def _tick(self, now: float) -> List[dict]:
+        out: List[dict] = []
+        for (origin, fp), (deadline, alert) in list(self._pending.items()):
+            if now < deadline:
+                continue
+            del self._pending[(origin, fp)]
+            target = self._route_map.get(
+                self._route_map[origin].escalate_to
+            )
+            if target is None:
+                continue
+            sev = self.severity(str(alert.get("state", "")))
+            # escalation bypasses the target's kind/severity/silence
+            # gates — it exists precisely because the normal path did
+            # not get the alert acknowledged
+            ok, detail = self._deliver(target, alert, fp, sev)
+            reason = f"escalated_from:{origin}"
+            if detail:
+                reason += f" {detail}"
+            out.append(self._note(
+                alert, fp, sev, now, route=target.name,
+                status="escalated" if ok else "failed", reason=reason,
+            ))
         return out
 
     def _gate(self, route: RouteSpec, fp: str, now: float) -> str:

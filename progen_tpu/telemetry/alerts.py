@@ -1,7 +1,7 @@
 """Alert sink: transition records → an alerts JSONL (webhook file).
 
 Alerts are the collector's *actionable* output — everything else it
-writes is evidence. Two kinds, both edge-triggered (a condition that
+writes is evidence. Three kinds, all edge-triggered (a condition that
 holds for an hour produces exactly two lines: onset and recovery):
 
   * ``kind:"staleness"`` — a source's ``up`` bit flipped: its
@@ -10,7 +10,11 @@ holds for an hour produces exactly two lines: onset and recovery):
   * ``kind:"slo_burn"`` — the fleet-SLO watchtower crossed a state
     edge (``warn``/``burning``/``resolved``), forwarded from
     ``SloWatch`` so the paging decision rides the *merged* fleet
-    series, not any single replica's file.
+    series, not any single replica's file;
+  * ``kind:"deploy_rollback"`` — the deploy controller reverted a
+    canary checkpoint (state ``rolled_back``, objective = the
+    checkpoint name), so a bad rollout pages through the same
+    pipeline as a burning SLO.
 
 The sink file uses the journal's write discipline (append, one line,
 flush) so a tail -f or a webhook relay can follow it live; ``ev:
@@ -37,8 +41,10 @@ from typing import Callable, Dict, List, Optional
 
 from progen_tpu.telemetry.spans import EventLog
 
-ALERT_KINDS = ("staleness", "slo_burn")
-ALERT_STATES = ("stale", "fresh", "warn", "burning", "resolved")
+ALERT_KINDS = ("staleness", "slo_burn", "deploy_rollback")
+ALERT_STATES = (
+    "stale", "fresh", "warn", "burning", "resolved", "rolled_back"
+)
 
 
 class AlertSink:
@@ -128,6 +134,23 @@ class AlertSink:
             "source": str(source),
             "objective": "",
             "age_s": round(float(age_s), 3),
+        })
+
+    def deploy_rollback(
+        self, ckpt: str, reason: str, now: Optional[float] = None
+    ) -> Optional[dict]:
+        """The deploy controller reverted ``ckpt`` — exactly-once per
+        checkpoint across controller restarts (the identity is
+        ``deploy_rollback|deploy|<ckpt>`` and a replayed rollback hits
+        the same-state dedup)."""
+        return self._emit({
+            "ev": "alert",
+            "ts": float(time.time() if now is None else now),
+            "kind": "deploy_rollback",
+            "state": "rolled_back",
+            "source": "deploy",
+            "objective": str(ckpt),
+            "reason": str(reason),
         })
 
     def slo_transition(self, slo_rec: dict) -> dict:

@@ -130,6 +130,20 @@ def clean_ship_metrics(reg):
     reg.set_gauge("archive_bytes", 1 << 20)
 
 
+def clean_deploy_consumer(records):
+    # consuming deploy-ledger records (kill-matrix asserts, the CI
+    # deployment smoke) is fine — only building the raw dict literal
+    # is restricted to progen_tpu/deploy/
+    return [r for r in records if r.get("op") == "converged"]
+
+
+def clean_deploy_metrics(reg):
+    # deploy-adjacent METRICS are fine anywhere — only raw ev:"deploy"
+    # records are restricted to progen_tpu/deploy/
+    reg.set_gauge("checkpoint_digest", 123456.0)
+    reg.inc("reload_rejected")
+
+
 def clean_other_ev_dict():
     # dict literals with other ev tags are not the collector's grammar
     return {"ev": "tsdb_block", "seq": 4, "level": 1}

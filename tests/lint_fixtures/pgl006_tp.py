@@ -1,4 +1,4 @@
-"""PGL006 true positives: telemetry hygiene. Expected findings: 44."""
+"""PGL006 true positives: telemetry hygiene. Expected findings: 47."""
 
 
 def unbounded_span(telemetry, name):
@@ -164,3 +164,17 @@ def bad_ship_op():
     # shipped/skipped/verify_failed retention alphabet
     return {"ev": "ship", "ts": 1.0, "op": "uploaded",
             "block": "block-00000001-l0.jsonl"}
+
+
+def raw_deploy_record():
+    # TP: deploy record built outside progen_tpu/deploy/ — it forges a
+    # canary/promote/rollback decision the controller never made
+    return {"ev": "deploy", "ts": 1.0, "op": "promote",
+            "ckpt": "ckpt_000001", "replica": "replica1"}
+
+
+def bad_deploy_op():
+    # TP x2: outside progen_tpu/deploy/ AND an op outside the
+    # observed/canary/probe/promote/rollback/converged alphabet
+    return {"ev": "deploy", "ts": 1.0, "op": "shipped",
+            "ckpt": "ckpt_000001"}

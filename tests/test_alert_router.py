@@ -260,6 +260,183 @@ class TestRestartReload:
         router2.close()
 
 
+class TestDeployRollbackRouting:
+    def test_rollback_alert_routes_as_critical(self, tmp_path):
+        sink = AlertSink(tmp_path / "alerts.jsonl")
+        rec = sink.deploy_rollback(
+            "ckpt_000001", "ppl_regression:10.2>10.1", now=5.0
+        )
+        sink.close()
+        assert rec is not None and rec["kind"] == "deploy_rollback"
+        router = _router(tmp_path, [
+            RouteSpec(name="page", min_severity="critical"),
+        ])
+        notes = router.handle(rec)
+        assert [(n["route"], n["status"]) for n in notes] == \
+            [("page", "sent")]
+        assert notes[0]["severity"] == "critical"
+        assert notes[0]["fingerprint"] == \
+            "deploy_rollback:deploy:ckpt_000001"
+        router.close()
+
+    def test_same_checkpoint_rollback_is_exactly_once(self, tmp_path):
+        """The controller replays its ledger on restart and re-fires
+        every recorded rollback into the sink — the sink's state dedup
+        is what keeps the webhook at one page per checkpoint."""
+        sink = AlertSink(tmp_path / "alerts.jsonl")
+        assert sink.deploy_rollback("ckpt_000001", "canary_timeout",
+                                    now=1.0) is not None
+        sink.close()
+        sink2 = AlertSink(tmp_path / "alerts.jsonl")
+        assert sink2.deploy_rollback("ckpt_000001", "canary_timeout",
+                                     now=2.0) is None
+        assert sink2.suppressed == 1
+        # a DIFFERENT condemned checkpoint is a fresh page
+        assert sink2.deploy_rollback("ckpt_000002", "probe_failed",
+                                     now=3.0) is not None
+        sink2.close()
+
+
+class TestEscalation:
+    """Unacked pages climb the chain: a warning+ alert sent through a
+    route with ``escalate_to`` re-fires through the target after
+    ``escalate_after_s`` unless a state change acked it first."""
+
+    CHAIN = [
+        # pager only takes slo_burn normally — so a staleness record
+        # reaching it proves the escalation bypassed the kind gate
+        RouteSpec(name="chat", escalate_to="pager",
+                  escalate_after_s=60.0),
+        RouteSpec(name="pager", kinds="slo_burn"),
+    ]
+
+    def test_spec_validation(self):
+        with pytest.raises(ValueError, match="set together"):
+            RouteSpec(name="x", escalate_to="y")
+        with pytest.raises(ValueError, match="set together"):
+            RouteSpec(name="x", escalate_after_s=5.0)
+        with pytest.raises(ValueError, match="itself"):
+            RouteSpec(name="x", escalate_to="x", escalate_after_s=5.0)
+
+    def test_unknown_target_raises(self, tmp_path):
+        with pytest.raises(ValueError, match="unknown"):
+            _router(tmp_path, [
+                RouteSpec(name="chat", escalate_to="nobody",
+                          escalate_after_s=5.0),
+            ])
+
+    def test_toml_keys_parse(self, tmp_path):
+        p = tmp_path / "r.toml"
+        p.write_text(
+            '[route_chat]\nsink = "file"\n'
+            'escalate_to = "pager"\nescalate_after_s = 300.0\n'
+            '[route_pager]\nsink = "file"\n'
+        )
+        _, routes = load_router_config(p)
+        by_name = {r.name: r for r in routes}
+        assert by_name["chat"].escalate_to == "pager"
+        assert by_name["chat"].escalate_after_s == 300.0
+
+    def test_fires_after_deadline_bypassing_target_gates(
+        self, tmp_path
+    ):
+        router = _router(tmp_path, self.CHAIN)
+        notes = router.handle(_alert(ts=10.0))
+        # normal delivery: chat only (pager's kind filter skips it)
+        assert [(n["route"], n["status"]) for n in notes] == \
+            [("chat", "sent")]
+        assert router.tick(now=30.0) == []  # not due yet
+        fired = router.tick(now=71.0)
+        assert [(n["route"], n["status"]) for n in fired] == \
+            [("pager", "escalated")]
+        assert fired[0]["reason"] == "escalated_from:chat"
+        assert fired[0]["fingerprint"] == "staleness:r0:"
+        assert router.counts["escalated"] == 1
+        # one-shot: the chain does not re-fire
+        assert router.tick(now=999.0) == []
+        router.close()
+
+    def test_state_change_disarms(self, tmp_path):
+        router = _router(tmp_path, self.CHAIN)
+        router.handle(_alert(ts=10.0))
+        # recovery before the deadline acks the page
+        router.handle(_alert(ts=20.0, state="fresh"))
+        assert router.tick(now=999.0) == []
+        assert router.counts["escalated"] == 0
+        router.close()
+
+    def test_info_severity_never_arms(self, tmp_path):
+        router = _router(tmp_path, self.CHAIN)
+        # a recovery edge is info-level: sent, but never escalation
+        # material (the chain exists for unacked PROBLEMS)
+        notes = router.handle(_alert(ts=10.0, state="fresh"))
+        assert [n["status"] for n in notes] == ["sent"]
+        assert router.tick(now=999.0) == []
+        router.close()
+
+    def test_escalated_delivery_does_not_cascade(self, tmp_path):
+        """pager's own escalate_to must not arm off an escalated
+        delivery — chains are one hop per edge, not loops."""
+        chain = [
+            RouteSpec(name="chat", escalate_to="pager",
+                      escalate_after_s=60.0),
+            RouteSpec(name="pager", kinds="slo_burn",
+                      escalate_to="chat", escalate_after_s=60.0),
+        ]
+        router = _router(tmp_path, chain)
+        router.handle(_alert(ts=10.0))
+        assert len(router.tick(now=71.0)) == 1
+        assert router.tick(now=9999.0) == []
+        router.close()
+
+    def test_pending_escalation_survives_restart(self, tmp_path):
+        router = _router(tmp_path, self.CHAIN)
+        router.handle(_alert(ts=10.0))
+        router.close()  # "crash" with the chain armed
+
+        router2 = _router(tmp_path, self.CHAIN)
+        fired = router2.tick(now=71.0)
+        assert [(n["route"], n["status"]) for n in fired] == \
+            [("pager", "escalated")]
+        router2.close()
+
+    def test_fired_escalation_not_replayed(self, tmp_path):
+        router = _router(tmp_path, self.CHAIN)
+        router.handle(_alert(ts=10.0))
+        assert len(router.tick(now=71.0)) == 1
+        router.close()
+        # the escalated record is on the ledger: a restart must not
+        # page again off the same edge
+        router2 = _router(tmp_path, self.CHAIN)
+        assert router2.tick(now=9999.0) == []
+        assert router2.counts["escalated"] == 1  # history, not re-fire
+        router2.close()
+
+    def test_resolved_edge_disarms_across_restart(self, tmp_path):
+        router = _router(tmp_path, self.CHAIN)
+        router.handle(_alert(ts=10.0))
+        router.handle(_alert(ts=20.0, state="fresh"))
+        router.close()
+        router2 = _router(tmp_path, self.CHAIN)
+        assert router2.tick(now=9999.0) == []
+        router2.close()
+
+    def test_escalation_to_webhook(self, tmp_path, receiver):
+        router = _router(tmp_path, [
+            RouteSpec(name="chat", escalate_to="hook",
+                      escalate_after_s=30.0),
+            RouteSpec(name="hook", sink="webhook", url=receiver.url,
+                      kinds="slo_burn"),
+        ])
+        router.handle(_alert(ts=10.0))
+        assert receiver.bodies == []  # kind gate held the normal path
+        fired = router.tick(now=41.0)
+        assert [n["status"] for n in fired] == ["escalated"]
+        body = json.loads(receiver.bodies[0])
+        assert body["alert"]["kind"] == "staleness"
+        router.close()
+
+
 class TestAlertSinkPersistence:
     def test_no_refire_after_restart(self, tmp_path):
         sink = AlertSink(tmp_path / "alerts.jsonl")

@@ -1,0 +1,786 @@
+"""Continuous deployment controller: canary, promote, rollback.
+
+The contract under test (deploy/controller.py + deploy/ledger.py):
+every decision is an fsync'd ``ev:"deploy"`` ledger record the
+controller replays on start, so a SIGKILL at any phase resumes
+idempotently — nothing already pinned is re-pinned, completed probes
+never re-run, a recorded rollback re-fires its alert into the sink's
+edge-dedup (exactly-once webhook). The pin/ack files, not the ledger,
+are the authority on what each replica serves.
+
+The fleet-level version of this contract (real serve subprocesses,
+SIGKILL, live traffic) lives in test_deploy_kill_matrix.py; here the
+replicas are directories and the test plays the serve side by writing
+acks by hand.
+"""
+
+import json
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from progen_tpu.checkpoint import (
+    Package,
+    checkpoint_digest,
+    get_checkpoint_fns,
+)
+from progen_tpu.config import ProGenConfig
+from progen_tpu.deploy import (
+    DEPLOY_OPS,
+    DeployController,
+    DeployLedger,
+    DeployPolicy,
+    Replica,
+    load_deploy_policy,
+    probe_stats,
+    read_ledger,
+    replay_state,
+)
+from progen_tpu.models.progen import ProGen
+from progen_tpu.telemetry.alerts import AlertSink
+
+TINY = ProGenConfig(
+    num_tokens=32,
+    dim=32,
+    seq_len=32,
+    depth=2,
+    window_size=8,
+    global_mlp_depth=1,
+    heads=2,
+    dim_head=16,
+    ff_mult=2,
+    dtype="float32",
+)
+
+# FASTA probe bytes need the byte-level vocab (collate maps raw bytes
+# +1 into the embedding; a 32-token table would index out of range)
+BYTE_CFG = ProGenConfig(
+    num_tokens=256,
+    dim=32,
+    seq_len=32,
+    depth=2,
+    window_size=8,
+    global_mlp_depth=1,
+    heads=2,
+    dim_head=16,
+    ff_mult=2,
+    dtype="float32",
+)
+
+
+def _init_params(model, config):
+    tokens = jnp.zeros((1, config.seq_len), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), tokens)
+    from flax.core import meta
+
+    return meta.unbox(variables)["params"]
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = ProGen(TINY)
+    return model, _init_params(model, TINY)
+
+
+@pytest.fixture(scope="module")
+def byte_model_and_params():
+    model = ProGen(BYTE_CFG)
+    return model, _init_params(model, BYTE_CFG)
+
+
+def _save(ck_dir, params, step=0, config=TINY):
+    _, _, save = get_checkpoint_fns(str(ck_dir))
+    return pathlib.Path(
+        save(Package(step, {"params": params}, config.to_dict(), "run"))
+    ).name
+
+
+def _replicas(root, n=3):
+    return [
+        Replica(f"replica{i}", pathlib.Path(root) / f"replica{i}")
+        for i in range(n)
+    ]
+
+
+def _ack(replica, ckpt, status, reason=""):
+    """Play the serve side: answer a pin the way reload.py would."""
+    body = {"pin": ckpt, "status": status, "ts": 0.0}
+    if reason:
+        body["reason"] = reason
+    replica.dir.mkdir(parents=True, exist_ok=True)
+    replica.ack_path.write_text(json.dumps(body))
+
+
+def _ack_pins(replicas):
+    """Commit every outstanding pin (the healthy-fleet default)."""
+    for r in replicas:
+        pin = r.pinned()
+        if pin is not None:
+            _ack(r, pin, "committed")
+
+
+class _Clock:
+    def __init__(self, now=1000.0):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+
+def _controller(ck, replicas, deploy_dir, **kw):
+    kw.setdefault("clock", _Clock())
+    return DeployController(ck, replicas, deploy_dir, **kw)
+
+
+class TestLedger:
+    def test_append_rejects_unknown_op(self, tmp_path):
+        led = DeployLedger(tmp_path / "deploy.jsonl")
+        with pytest.raises(ValueError, match="unknown deploy op"):
+            led.append("shipped", "ckpt_000000")
+        led.close()
+
+    def test_records_survive_roundtrip_torn_tail_skipped(self, tmp_path):
+        path = tmp_path / "deploy.jsonl"
+        led = DeployLedger(path)
+        for op in DEPLOY_OPS:
+            led.append(op, "ckpt_000001", ts=1.0)
+        led.close()
+        # a kill mid-write leaves a torn last line: replay must skip it
+        with open(path, "a", encoding="utf-8") as f:
+            f.write('{"ev": "deploy", "op": "conv')
+        recs = read_ledger(path)
+        assert [r["op"] for r in recs] == list(DEPLOY_OPS)
+        assert all(r["ev"] == "deploy" for r in recs)
+
+    def test_read_missing_ledger_is_empty(self, tmp_path):
+        assert read_ledger(tmp_path / "nope.jsonl") == []
+
+    def test_replay_folds_full_lifecycle(self, tmp_path):
+        led = DeployLedger(tmp_path / "deploy.jsonl")
+        led.append("observed", "ckpt_000000", ts=1.0, digest="aa")
+        led.append("converged", "ckpt_000000", ts=1.0, digest="aa")
+        led.append("observed", "ckpt_000001", ts=2.0, digest="bb")
+        led.append("canary", "ckpt_000001", ts=3.0, replica="replica0")
+        led.append("probe", "ckpt_000001", ts=4.0, ppl=9.5)
+        led.append("promote", "ckpt_000001", ts=5.0, replica="replica1")
+        led.close()
+        st = replay_state(read_ledger(tmp_path / "deploy.jsonl"))
+        assert st.fleet == "ckpt_000000" and st.fleet_digest == "aa"
+        assert st.candidate == "ckpt_000001"
+        assert "ckpt_000001" in st.canaried
+        assert st.probes["ckpt_000001"]["ppl"] == 9.5
+        assert set(st.promoted["ckpt_000001"]) == {"replica1"}
+
+    def test_replay_rollback_retires_candidate_forever(self, tmp_path):
+        led = DeployLedger(tmp_path / "deploy.jsonl")
+        led.append("converged", "ckpt_000000", ts=1.0)
+        led.append("observed", "ckpt_000001", ts=2.0)
+        led.append("rollback", "ckpt_000001", ts=3.0,
+                   to="ckpt_000000", reason="canary_timeout")
+        led.close()
+        st = replay_state(read_ledger(tmp_path / "deploy.jsonl"))
+        assert st.candidate is None
+        assert st.fleet == "ckpt_000000"
+        assert "ckpt_000001" in st.failed
+        assert len(st.rollbacks) == 1
+
+    def test_converged_settles_candidate(self, tmp_path):
+        led = DeployLedger(tmp_path / "deploy.jsonl")
+        led.append("converged", "ckpt_000000", ts=1.0)
+        led.append("observed", "ckpt_000001", ts=2.0)
+        led.append("converged", "ckpt_000001", ts=3.0, digest="cc")
+        led.close()
+        st = replay_state(read_ledger(tmp_path / "deploy.jsonl"))
+        assert st.fleet == "ckpt_000001" and st.candidate is None
+
+
+class TestPolicy:
+    def test_defaults_validate(self):
+        pol = DeployPolicy()
+        assert pol.interval_s > 0 and pol.ack_timeout_s > 0
+
+    def test_shipped_example_parses(self):
+        pol = load_deploy_policy("configs/serving/deploy.toml")
+        assert pol == DeployPolicy()  # the example documents defaults
+
+    @pytest.mark.parametrize("kw", [
+        {"interval_s": 0.0},
+        {"ack_timeout_s": 0.0},
+        {"max_ppl_regression_pct": -1.0},
+        {"max_ttft_regression_pct": -0.5},
+        {"probe_batch_size": 0},
+    ])
+    def test_bad_values_raise(self, kw):
+        with pytest.raises(ValueError):
+            DeployPolicy(**kw)
+
+    def test_toml_roundtrip(self, tmp_path):
+        p = tmp_path / "deploy.toml"
+        p.write_text(
+            "[deploy]\n"
+            "interval_s = 0.5\n"
+            'canary = "replica1"\n'
+            "max_ppl_regression_pct = 2.5\n"
+            "ack_timeout_s = 30.0\n"
+        )
+        pol = load_deploy_policy(p)
+        assert pol.interval_s == 0.5 and pol.canary == "replica1"
+        assert pol.max_ppl_regression_pct == 2.5
+        assert pol.probe_batch_size == DeployPolicy().probe_batch_size
+
+    def test_unknown_key_raises(self, tmp_path):
+        p = tmp_path / "deploy.toml"
+        p.write_text("[deploy]\nmax_ppl_regresion_pct = 2.5\n")  # typo
+        with pytest.raises(ValueError, match="unknown deploy key"):
+            load_deploy_policy(p)
+
+
+class TestReplica:
+    def test_pin_is_idempotent_on_equal_content(self, tmp_path):
+        r = Replica("replica0", tmp_path / "replica0")
+        assert r.pinned() is None
+        assert r.pin("ckpt_000001") is True
+        assert r.pinned() == "ckpt_000001"
+        # the replay seam: re-pinning the same name must not rewrite
+        # the file (a watching replica would see no change either way)
+        assert r.pin("ckpt_000001") is False
+        assert r.pin("ckpt_000002") is True
+
+    def test_ack_states(self, tmp_path):
+        r = Replica("replica0", tmp_path / "replica0")
+        assert r.ack() is None and not r.on("ckpt_000001")
+        _ack(r, "ckpt_000001", "committed")
+        assert r.on("ckpt_000001")
+        assert r.rejected("ckpt_000001") is None
+        # an ack for another pin is not an answer for this one
+        assert not r.on("ckpt_000002")
+        assert r.ack_for("ckpt_000002") is None
+        _ack(r, "ckpt_000002", "rejected", "pin_unavailable")
+        assert r.rejected("ckpt_000002") == "pin_unavailable"
+        assert not r.on("ckpt_000002")
+
+
+class TestProbeStats:
+    def _write_shard(self, out_dir, idx, rows):
+        out_dir.mkdir(parents=True, exist_ok=True)
+        with open(out_dir / f"scores-{idx:05d}.jsonl", "w") as f:
+            for row in rows:
+                f.write(json.dumps(row) + "\n")
+
+    def test_shard_layout_invariant(self, tmp_path):
+        """The same rows split differently across shards — including a
+        duplicate from a resume re-score — reduce to the same bits."""
+        rows = [
+            {"id": "a", "n_tokens": 10, "nll": 1.25},
+            {"id": "b", "n_tokens": 7, "nll": 0.5},
+            {"id": "c", "n_tokens": 3, "nll": 2.0},
+        ]
+        one = tmp_path / "one"
+        self._write_shard(one, 0, rows)
+        split = tmp_path / "split"
+        self._write_shard(split, 0, [rows[2]])
+        self._write_shard(split, 1, [rows[0], rows[2]])  # dup of "c"
+        self._write_shard(split, 2, [rows[1]])
+        assert probe_stats(one) == probe_stats(split)
+        assert probe_stats(one)["n"] == 3
+        assert probe_stats(one)["tokens"] == 20
+
+    def test_empty_dir_is_infinite(self, tmp_path):
+        stats = probe_stats(tmp_path / "nothing")
+        assert stats["ppl"] == float("inf") and stats["n"] == 0
+
+
+class TestControllerLifecycle:
+    """Fake replica dirs; the test writes the acks serve would."""
+
+    def _fleet(self, tmp_path, model_and_params, n=3, **kw):
+        _, params = model_and_params
+        ck = tmp_path / "ck"
+        name_a = _save(ck, params)
+        replicas = _replicas(tmp_path, n)
+        ctrl = _controller(ck, replicas, tmp_path / "deploy", **kw)
+        return ck, name_a, replicas, ctrl
+
+    def test_fresh_ledger_adopts_newest(
+        self, tmp_path, model_and_params
+    ):
+        ck, name_a, replicas, ctrl = self._fleet(
+            tmp_path, model_and_params
+        )
+        assert ctrl.tick() == "converged"
+        assert ctrl.state.fleet == name_a
+        assert all(r.pinned() == name_a for r in replicas)
+        recs = read_ledger(tmp_path / "deploy" / "deploy.jsonl")
+        assert [r["op"] for r in recs] == ["observed", "converged"]
+        assert all(r.get("adopted") for r in recs)
+        assert recs[-1]["digest"] == checkpoint_digest(ck / name_a)
+        # idle: nothing new to do
+        assert ctrl.tick() is None
+        ctrl.close()
+
+    def test_empty_store_stays_idle(self, tmp_path):
+        ctrl = _controller(
+            tmp_path / "nothing", _replicas(tmp_path, 1),
+            tmp_path / "deploy",
+        )
+        assert ctrl.tick() is None
+        assert ctrl.state.fleet is None
+        ctrl.close()
+
+    def test_full_promote_is_rolling_and_ordered(
+        self, tmp_path, model_and_params
+    ):
+        _, params = model_and_params
+        ck, name_a, replicas, ctrl = self._fleet(
+            tmp_path, model_and_params
+        )
+        assert ctrl.tick() == "converged"  # adopt A
+        name_b = _save(ck, jax.tree.map(lambda x: x * 1.5, params), 1)
+
+        assert ctrl.tick() == "observed"
+        assert ctrl.state.candidate == name_b
+        # canary pinned first; the rest of the fleet stays on A
+        assert ctrl.tick() == "canary"
+        assert replicas[0].pinned() == name_b
+        assert all(r.pinned() == name_a for r in replicas[1:])
+        assert ctrl.tick() is None  # waiting on the canary's ack
+        _ack(replicas[0], name_b, "committed")
+
+        # rolling promote: one replica per tick, each gated on the
+        # previous ack — B never reaches replica2 before replica1 acked
+        assert ctrl.tick() == "promote"
+        assert replicas[1].pinned() == name_b
+        assert replicas[2].pinned() == name_a
+        assert ctrl.tick() is None
+        _ack(replicas[1], name_b, "committed")
+        assert ctrl.tick() == "promote"
+        assert replicas[2].pinned() == name_b
+        _ack(replicas[2], name_b, "committed")
+        assert ctrl.tick() == "converged"
+        assert ctrl.state.fleet == name_b
+        assert ctrl.state.fleet_digest == \
+            checkpoint_digest(ck / name_b)
+        assert ctrl.tick() is None
+        ctrl.close()
+
+    def test_canary_rejection_rolls_back_everyone(
+        self, tmp_path, model_and_params
+    ):
+        _, params = model_and_params
+        pages = []
+        ck, name_a, replicas, ctrl = self._fleet(
+            tmp_path, model_and_params,
+            alerts=AlertSink(tmp_path / "alerts.jsonl",
+                             relay=pages.append),
+        )
+        ctrl.tick()  # adopt
+        _ack_pins(replicas)
+        name_b = _save(ck, jax.tree.map(lambda x: x + 1.0, params), 1)
+        ctrl.tick()  # observed
+        ctrl.tick()  # canary
+        _ack(replicas[0], name_b, "rejected", "digest_mismatch")
+        assert ctrl.tick() == "rollback"
+        assert all(r.pinned() == name_a for r in replicas)
+        assert name_b in ctrl.state.failed
+        # the rejected candidate's weights never reach the others
+        recs = read_ledger(tmp_path / "deploy" / "deploy.jsonl")
+        assert not any(r["op"] == "promote" for r in recs)
+        rb = [r for r in recs if r["op"] == "rollback"]
+        assert rb[0]["to"] == name_a
+        assert rb[0]["reason"] == "canary_rejected:digest_mismatch"
+        # exactly one page, through the existing alert pipeline
+        assert [p["kind"] for p in pages] == ["deploy_rollback"]
+        assert pages[0]["objective"] == name_b
+        # the failed candidate is never retried
+        assert ctrl.tick() is None
+        assert replicas[0].pinned() == name_a
+        ctrl.close()
+
+    def test_canary_ack_timeout_rolls_back(
+        self, tmp_path, model_and_params
+    ):
+        _, params = model_and_params
+        clock = _Clock()
+        ck, name_a, replicas, ctrl = self._fleet(
+            tmp_path, model_and_params,
+            policy=DeployPolicy(ack_timeout_s=60.0), clock=clock,
+        )
+        ctrl.tick()  # adopt
+        name_b = _save(ck, jax.tree.map(lambda x: x * 2.0, params), 1)
+        ctrl.tick()  # observed
+        ctrl.tick()  # canary
+        clock.now += 30.0
+        assert ctrl.tick() is None  # still within the window
+        clock.now += 31.0
+        assert ctrl.tick() == "rollback"
+        recs = read_ledger(tmp_path / "deploy" / "deploy.jsonl")
+        assert recs[-1]["reason"] == "canary_timeout"
+        ctrl.close()
+
+    def test_promote_rejection_rolls_back(
+        self, tmp_path, model_and_params
+    ):
+        _, params = model_and_params
+        ck, name_a, replicas, ctrl = self._fleet(
+            tmp_path, model_and_params
+        )
+        ctrl.tick()  # adopt
+        name_b = _save(ck, jax.tree.map(lambda x: x * 3.0, params), 1)
+        ctrl.tick()  # observed
+        ctrl.tick()  # canary
+        _ack(replicas[0], name_b, "committed")
+        ctrl.tick()  # promote replica1
+        _ack(replicas[1], name_b, "rejected", "incompatible_tree")
+        assert ctrl.tick() == "rollback"
+        assert all(r.pinned() == name_a for r in replicas)
+        recs = read_ledger(tmp_path / "deploy" / "deploy.jsonl")
+        assert recs[-1]["reason"] == \
+            "promote_rejected:replica1:incompatible_tree"
+        ctrl.close()
+
+    def test_named_canary_is_honored(self, tmp_path, model_and_params):
+        _, params = model_and_params
+        ck, name_a, replicas, ctrl = self._fleet(
+            tmp_path, model_and_params,
+            policy=DeployPolicy(canary="replica2"),
+        )
+        ctrl.tick()  # adopt
+        name_b = _save(ck, jax.tree.map(lambda x: x * 1.1, params), 1)
+        ctrl.tick()  # observed
+        ctrl.tick()  # canary
+        assert replicas[2].pinned() == name_b
+        assert replicas[0].pinned() == name_a
+        ctrl.close()
+
+    def test_unknown_canary_name_raises(
+        self, tmp_path, model_and_params
+    ):
+        _, params = model_and_params
+        ck = tmp_path / "ck"
+        _save(ck, params)
+        with pytest.raises(ValueError, match="not in replicas"):
+            _controller(
+                ck, _replicas(tmp_path, 2), tmp_path / "deploy",
+                policy=DeployPolicy(canary="replica9"),
+            )
+
+
+class TestControllerRestart:
+    """SIGKILL-at-any-phase, in miniature: drop the controller object
+    mid-pipeline, rebuild from the ledger, assert it resumes without
+    repeating completed work."""
+
+    def _start(self, tmp_path, model_and_params, **kw):
+        _, params = model_and_params
+        ck = tmp_path / "ck"
+        name_a = _save(ck, params)
+        replicas = _replicas(tmp_path, 3)
+        ctrl = _controller(ck, replicas, tmp_path / "deploy", **kw)
+        ctrl.tick()  # adopt A
+        _ack_pins(replicas)
+        name_b = _save(
+            ck, jax.tree.map(lambda x: x * 1.5, params), 1
+        )
+        return ck, name_a, name_b, replicas, ctrl
+
+    def test_restart_mid_promote_does_not_repin_or_skip(
+        self, tmp_path, model_and_params
+    ):
+        ck, name_a, name_b, replicas, ctrl = self._start(
+            tmp_path, model_and_params
+        )
+        ctrl.tick()  # observed
+        ctrl.tick()  # canary
+        _ack(replicas[0], name_b, "committed")
+        ctrl.tick()  # promote replica1 (recorded, not yet acked)
+        before = replicas[1].pin_path.stat().st_mtime_ns
+        ctrl.close()  # "SIGKILL"
+
+        ctrl2 = _controller(ck, replicas, tmp_path / "deploy")
+        # replica1's promote is on the ledger: wait for its ack, do
+        # NOT rewrite its pin and do NOT jump ahead to replica2
+        assert ctrl2.tick() is None
+        assert replicas[1].pin_path.stat().st_mtime_ns == before
+        assert replicas[2].pinned() == name_a
+        _ack(replicas[1], name_b, "committed")
+        assert ctrl2.tick() == "promote"
+        assert replicas[2].pinned() == name_b
+        _ack(replicas[2], name_b, "committed")
+        assert ctrl2.tick() == "converged"
+        recs = read_ledger(tmp_path / "deploy" / "deploy.jsonl")
+        promotes = [r for r in recs if r["op"] == "promote"]
+        # one promote record per non-canary replica, never repeated
+        assert sorted(r["replica"] for r in promotes) == \
+            ["replica1", "replica2"]
+        ctrl2.close()
+
+    def test_restart_mid_canary_keeps_waiting(
+        self, tmp_path, model_and_params
+    ):
+        ck, name_a, name_b, replicas, ctrl = self._start(
+            tmp_path, model_and_params
+        )
+        ctrl.tick()  # observed
+        ctrl.tick()  # canary (pin written, no ack yet)
+        ctrl.close()
+
+        ctrl2 = _controller(ck, replicas, tmp_path / "deploy")
+        assert ctrl2.tick() is None  # no second canary record
+        recs = read_ledger(tmp_path / "deploy" / "deploy.jsonl")
+        assert [r["op"] for r in recs].count("canary") == 1
+        _ack(replicas[0], name_b, "committed")
+        assert ctrl2.tick() == "promote"
+        ctrl2.close()
+
+    def test_rollback_alert_exactly_once_across_restart(
+        self, tmp_path, model_and_params
+    ):
+        pages = []
+        sink = AlertSink(tmp_path / "alerts.jsonl", relay=pages.append)
+        ck, name_a, name_b, replicas, ctrl = self._start(
+            tmp_path, model_and_params, alerts=sink,
+        )
+        ctrl.tick()  # observed
+        ctrl.tick()  # canary
+        _ack(replicas[0], name_b, "rejected", "digest_mismatch")
+        assert ctrl.tick() == "rollback"
+        assert len(pages) == 1
+        ctrl.close()
+        sink.close()
+
+        # restart replays the ledger and re-fires the rollback into
+        # the sink; the sink's persisted state dedups the page
+        pages2 = []
+        sink2 = AlertSink(tmp_path / "alerts.jsonl",
+                          relay=pages2.append)
+        ctrl2 = _controller(
+            ck, replicas, tmp_path / "deploy", alerts=sink2
+        )
+        assert pages2 == []
+        assert sink2.suppressed == 1
+        assert ctrl2.tick() is None
+        ctrl2.close()
+        sink2.close()
+
+    def test_restart_mid_rollback_finishes_the_repins(
+        self, tmp_path, model_and_params
+    ):
+        """A kill between a rollback's pin writes may leave a replica
+        still pinned to the condemned candidate; the idle safety net
+        re-asserts the fleet pin on the next tick."""
+        ck, name_a, name_b, replicas, ctrl = self._start(
+            tmp_path, model_and_params
+        )
+        ctrl.tick()  # observed
+        ctrl.tick()  # canary
+        _ack(replicas[0], name_b, "rejected", "digest_mismatch")
+        ctrl.tick()  # rollback (all pins back to A)
+        ctrl.close()
+        # simulate the torn rollback: the candidate pin resurrected
+        replicas[0].pin(name_b)
+
+        ctrl2 = _controller(ck, replicas, tmp_path / "deploy")
+        assert ctrl2.tick() is None
+        assert all(r.pinned() == name_a for r in replicas)
+        ctrl2.close()
+
+
+class TestProbeGate:
+    """The probe verdict, with measurements planted on the ledger (the
+    real scorer runs in TestProbeDeterminism — here only the gate's
+    arithmetic and rollback wiring are under test)."""
+
+    def _canaried_fleet(self, tmp_path, model_and_params, policy,
+                        probe_fasta="unused.fa"):
+        _, params = model_and_params
+        ck = tmp_path / "ck"
+        name_a = _save(ck, params)
+        replicas = _replicas(tmp_path, 2)
+        ctrl = _controller(
+            ck, replicas, tmp_path / "deploy",
+            policy=policy, probe_fasta=probe_fasta,
+        )
+        ctrl.tick()  # adopt
+        _ack_pins(replicas)
+        name_b = _save(ck, jax.tree.map(lambda x: x * 1.5, params), 1)
+        ctrl.tick()  # observed
+        ctrl.tick()  # canary
+        _ack(replicas[0], name_b, "committed")
+        return name_a, name_b, replicas, ctrl
+
+    def test_ppl_within_limit_promotes(self, tmp_path, model_and_params):
+        name_a, name_b, replicas, ctrl = self._canaried_fleet(
+            tmp_path, model_and_params,
+            DeployPolicy(max_ppl_regression_pct=1.0),
+        )
+        ctrl._append("probe", name_a, ppl=10.0, n=4, tokens=40)
+        ctrl._append("probe", name_b, ppl=10.05, n=4, tokens=40)
+        assert ctrl.tick() == "promote"
+        assert replicas[1].pinned() == name_b
+        ctrl.close()
+
+    def test_ppl_regression_rolls_back(self, tmp_path, model_and_params):
+        name_a, name_b, replicas, ctrl = self._canaried_fleet(
+            tmp_path, model_and_params,
+            DeployPolicy(max_ppl_regression_pct=1.0),
+        )
+        ctrl._append("probe", name_a, ppl=10.0, n=4, tokens=40)
+        ctrl._append("probe", name_b, ppl=10.2, n=4, tokens=40)
+        assert ctrl.tick() == "rollback"
+        assert all(r.pinned() == name_a for r in replicas)
+        recs = read_ledger(tmp_path / "deploy" / "deploy.jsonl")
+        assert recs[-1]["reason"].startswith("ppl_regression:")
+        ctrl.close()
+
+    def test_ttft_regression_rolls_back(self, tmp_path, model_and_params):
+        name_a, name_b, replicas, ctrl = self._canaried_fleet(
+            tmp_path, model_and_params,
+            DeployPolicy(max_ppl_regression_pct=50.0,
+                         max_ttft_regression_pct=10.0),
+        )
+        # the observed-time snapshot vs a slower live fleet
+        ctrl.state.observed[name_b]["baseline_ttft_p95_s"] = 0.10
+        ctrl._fleet_ttft = lambda: 0.15
+        ctrl._append("probe", name_a, ppl=10.0, n=4, tokens=40)
+        ctrl._append("probe", name_b, ppl=10.0, n=4, tokens=40)
+        assert ctrl.tick() == "rollback"
+        recs = read_ledger(tmp_path / "deploy" / "deploy.jsonl")
+        assert recs[-1]["reason"].startswith("ttft_regression:")
+        ctrl.close()
+
+    def test_probe_order_fleet_baseline_first(
+        self, tmp_path, model_and_params
+    ):
+        """The gate never compares against a ppl it didn't measure: the
+        fleet checkpoint is probed before the candidate."""
+        name_a, name_b, replicas, ctrl = self._canaried_fleet(
+            tmp_path, model_and_params, DeployPolicy(),
+        )
+        probed = []
+        ctrl._probe = lambda ckpt: (
+            probed.append(ckpt) or {"ppl": 10.0, "n": 1, "tokens": 4}
+        )
+        assert ctrl.tick() == "probe"
+        assert ctrl.tick() == "probe"
+        assert probed == [name_a, name_b]
+        ctrl.close()
+
+    def test_probe_crash_rolls_back(self, tmp_path, model_and_params):
+        name_a, name_b, replicas, ctrl = self._canaried_fleet(
+            tmp_path, model_and_params, DeployPolicy(),
+        )
+        ctrl._append("probe", name_a, ppl=10.0, n=4, tokens=40)
+
+        def boom(ckpt):
+            raise RuntimeError("checkpoint not restorable")
+
+        ctrl._probe = boom
+        assert ctrl.tick() == "rollback"
+        recs = read_ledger(tmp_path / "deploy" / "deploy.jsonl")
+        assert recs[-1]["reason"] == "probe_failed:RuntimeError"
+        ctrl.close()
+
+
+PROBE_FASTA = """\
+>p0 probe
+MKTAYIAKQR
+>p1 probe
+GDSLAVLLTT
+>p2 probe
+MKVLAAGIAT
+>p3 probe
+TTQLLASGDK
+>p4 probe
+MAGWNAYIDN
+>p5 probe
+LKSVETRGHH
+"""
+
+
+class TestProbeDeterminism:
+    """Satellite contract: probe NLL/ppl is bit-identical no matter how
+    many controller restarts interrupt the scoring."""
+
+    @pytest.fixture()
+    def probe_fasta(self, tmp_path):
+        p = tmp_path / "probe.fa"
+        p.write_text(PROBE_FASTA)
+        return str(p)
+
+    def test_interrupted_probe_resumes_bit_identical(
+        self, tmp_path, byte_model_and_params, probe_fasta
+    ):
+        from progen_tpu.workloads import fasta_records, run_batch_score
+
+        model, params = byte_model_and_params
+        full = tmp_path / "full"
+        run_batch_score(
+            model, params,
+            fasta_records(probe_fasta), str(full),
+            batch_size=2, logprobs=False,
+        )
+        # "SIGKILL mid-probe": stop after one batch, then resume
+        torn = tmp_path / "torn"
+        run_batch_score(
+            model, params,
+            fasta_records(probe_fasta), str(torn),
+            batch_size=2, logprobs=False, max_batches=1,
+        )
+        partial = probe_stats(torn)
+        assert 0 < partial["n"] < 6
+        run_batch_score(
+            model, params,
+            fasta_records(probe_fasta), str(torn),
+            batch_size=2, logprobs=False, resume=True,
+        )
+        a, b = probe_stats(full), probe_stats(torn)
+        assert a["n"] == b["n"] == 6
+        assert a["tokens"] == b["tokens"]
+        assert a["ppl"] == b["ppl"]  # bitwise, not approx
+
+    def test_controller_resumes_torn_probe(
+        self, tmp_path, byte_model_and_params, probe_fasta
+    ):
+        """A controller killed mid-probe re-enters _probe on restart;
+        the scorer's shard dedupe keeps the completed rows and the
+        final stats match an uninterrupted run's bits."""
+        from progen_tpu.workloads import fasta_records, run_batch_score
+
+        model, params = byte_model_and_params
+        ck = tmp_path / "ck"
+        name_a = _save(ck, params, config=BYTE_CFG)
+        replicas = _replicas(tmp_path, 2)
+        policy = DeployPolicy(
+            probe_batch_size=2, max_ppl_regression_pct=100.0
+        )
+        ctrl = _controller(
+            ck, replicas, tmp_path / "deploy",
+            policy=policy, probe_fasta=probe_fasta,
+        )
+        ctrl.tick()  # adopt
+        _ack_pins(replicas)
+        # same weights, new checkpoint dir
+        name_b = _save(ck, params, 1, config=BYTE_CFG)
+        ctrl.tick()  # observed
+        ctrl.tick()  # canary
+        _ack(replicas[0], name_b, "committed")
+        # plant a torn fleet probe — exactly what a SIGKILL mid-probe
+        # leaves on disk — in the dir the controller will score into
+        run_batch_score(
+            model, params,
+            fasta_records(probe_fasta),
+            str(tmp_path / "deploy" / "probes" / name_a),
+            batch_size=2, logprobs=False, max_batches=1,
+        )
+        assert ctrl.tick() == "probe"  # resumes + finishes the fleet probe
+        assert ctrl.tick() == "probe"  # candidate probe (clean run)
+        recs = read_ledger(tmp_path / "deploy" / "deploy.jsonl")
+        probes = {r["ckpt"]: r for r in recs if r["op"] == "probe"}
+        assert probes[name_a]["n"] == probes[name_b]["n"] == 6
+        # identical weights through the interrupted and the clean path:
+        # the resume machinery added nothing and lost nothing
+        assert probes[name_a]["ppl"] == probes[name_b]["ppl"]
+        assert ctrl.tick() == "promote"  # and the gate passes
+        ctrl.close()

@@ -1,0 +1,647 @@
+"""Deploy kill-matrix: SIGKILL the deploy controller and replicas at
+injected chaos points (PROGEN_CHAOS) mid-canary and mid-promote, with
+live traffic flowing through the router, and assert the continuous-
+deployment invariants across the whole fleet:
+
+  1. the fleet converges to exactly ONE checkpoint digest — the new one
+     when the pipeline completes (a restarted controller resumes from
+     the ledger), the old one when it rolls back (a dead canary's
+     weights never reach the rest of the fleet);
+  2. zero lost accepted requests across every wave — requests riding a
+     weight swap settle via between-step ``commit_params``, requests on
+     a killed replica hand off to survivors;
+  3. traffic before the deploy is bit-identical to ``sample_fast`` on
+     the OLD weights, traffic after convergence to the NEW weights
+     (after rollback: still the old) — the swap is atomic per stream;
+  4. the surviving replicas' ``decode_compile_count`` stays at 1 — the
+     swap recompiled nothing;
+  5. a rollback pages ``deploy_rollback`` through the alert sink
+     exactly once, and the condemned candidate is never retried.
+
+Real subprocesses throughout: ``cli/serve --reload_pin`` replicas, one
+``cli/router`` front, and ``cli/deploy`` as the controller. Traffic
+runs in waves so parity has a stable weight identity: wave1 drains
+before the candidate is published, wave2 rides the deploy (exactly-once
+only — its streams may span the swap), wave3 runs after the fleet
+settles. One controller-kill and one canary-kill case run in tier-1;
+their phase-shifted twins are ``slow``.
+"""
+
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from tests.test_router_kill_matrix import (
+    KILL_CFG,
+    _decode_compile_count,
+    _env,
+    _journal_accepts,
+    _parse_events,
+    _public_id,
+    _pump,
+    _spawn_router,
+    _stop_replica,
+    _wait_sockets,
+)
+
+@pytest.fixture(scope="module")
+def models():
+    """One model, two weight sets: A (the fleet baseline) and B (the
+    candidate). Saved per-test — the store is mutated mid-test."""
+    import jax
+    import jax.numpy as jnp
+    from flax.core import meta
+
+    from progen_tpu.config import ProGenConfig
+    from progen_tpu.models.progen import ProGen
+
+    config = ProGenConfig(**KILL_CFG)
+    model = ProGen(config)
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, config.seq_len), jnp.int32)
+    )
+    params_a = meta.unbox(variables)["params"]
+    params_b = jax.tree.map(lambda x: x * 1.5, params_a)
+    return {"model": model, "config": config,
+            "a": params_a, "b": params_b}
+
+
+def _save(ck, params, step, config):
+    from progen_tpu.checkpoint import Package, get_checkpoint_fns
+
+    _, _, save = get_checkpoint_fns(str(ck))
+    return Path(
+        save(Package(step, {"params": params}, config.to_dict(), "dkm"))
+    ).name
+
+
+def _spawn_pinned_replica(ck, rdir, *, chaos=""):
+    """A serve replica that honors its ``reload.pin`` control file —
+    the deploy controller's per-replica seam."""
+    rdir = Path(rdir)
+    rdir.mkdir(parents=True, exist_ok=True)
+    args = [
+        sys.executable, "-m", "progen_tpu.cli.serve",
+        "--checkpoint_path", str(ck),
+        "--max-slots", "2", "--max-queue", "16", "--max-len", "24",
+        "--socket", str(rdir / "serve.sock"),
+        "--journal_dir", str(rdir),
+        "--prom_file", str(rdir / "metrics.prom"),
+        "--metrics-every", "2",
+        "--reload_watch", "0.5",
+        "--reload_pin", str(rdir / "reload.pin"),
+    ]
+    return subprocess.Popen(
+        args, stdin=subprocess.DEVNULL, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, env=_env(chaos), text=True, bufsize=1,
+    )
+
+
+def _spawn_controller(ck, rdirs, deploy_dir, *, chaos="", alerts=None,
+                      policy=None, interval=0.3):
+    """cli/deploy over explicit --replica name=DIR specs; stderr goes
+    to ``deploy_dir/controller.log`` (appended across restarts) so a
+    SIGKILL cannot strand a half-full pipe."""
+    deploy_dir = Path(deploy_dir)
+    deploy_dir.mkdir(parents=True, exist_ok=True)
+    args = [
+        sys.executable, "-m", "progen_tpu.cli.deploy",
+        "--checkpoint_path", str(ck),
+        "--deploy_dir", str(deploy_dir),
+        "--interval", str(interval),
+    ]
+    for i, rdir in enumerate(rdirs):
+        args += ["--replica", f"replica{i}={rdir}"]
+    if alerts is not None:
+        args += ["--alerts", str(alerts)]
+    if policy is not None:
+        args += ["--policy", str(policy)]
+    return subprocess.Popen(
+        args, stdin=subprocess.DEVNULL, stdout=subprocess.DEVNULL,
+        stderr=open(deploy_dir / "controller.log", "a"),
+        env=_env(chaos),
+    )
+
+
+def _ledger(deploy_dir):
+    from progen_tpu.telemetry.trace import iter_jsonl
+
+    path = Path(deploy_dir) / "deploy.jsonl"
+    if not path.exists():
+        return []
+    return [r for r in iter_jsonl(path) if r.get("ev") == "deploy"]
+
+
+def _wait_ledger(deploy_dir, pred, timeout_s, what):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        recs = _ledger(deploy_dir)
+        if pred(recs):
+            return recs
+    log = Path(deploy_dir) / "controller.log"
+    tail = log.read_text()[-2000:] if log.exists() else ""
+    pytest.fail(f"ledger never showed {what}:\n"
+                f"{[r.get('op') for r in _ledger(deploy_dir)]}\n{tail}")
+
+
+def _ack_of(rdir):
+    try:
+        return json.loads((Path(rdir) / "reload.pin.ack").read_text())
+    except (OSError, ValueError):
+        return None
+
+
+def _wait_ack(rdir, ckpt, timeout_s=120):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        ack = _ack_of(rdir)
+        if ack and ack.get("pin") == ckpt \
+                and ack.get("status") == "committed":
+            return ack
+        time.sleep(0.25)
+    pytest.fail(f"{rdir} never acked {ckpt}: last {_ack_of(rdir)}")
+
+
+def _digest_gauge_of(ck, name):
+    from progen_tpu.checkpoint import checkpoint_digest, digest_gauge
+
+    return digest_gauge(checkpoint_digest(Path(ck) / name))
+
+
+def _prom_digest(rdir):
+    import re
+
+    text = (Path(rdir) / "metrics.prom").read_text()
+    m = re.search(
+        r"^progen_serve_checkpoint_digest (\S+)$", text, re.M
+    )
+    assert m, text
+    return float(m.group(1))
+
+
+def _send_wave(router, ids, length=16):
+    lines = [
+        json.dumps({
+            "id": rid, "prime": "MKV", "length": length,
+            "seed": 70 + j,
+        })
+        for j, rid in enumerate(ids)
+    ]
+    router.stdin.write("\n".join(lines) + "\n")
+    router.stdin.flush()
+
+
+def _wait_done(router, out_lines, err_lines, ids, timeout_s=600):
+    want = set(ids)
+
+    def settled():
+        _, done, rejected = _parse_events(out_lines)
+        return want <= (set(done) | {r["id"] for r in rejected})
+
+    assert _pump(router, out_lines, err_lines, settled, timeout_s), (
+        f"wave {sorted(want)} never settled:\n"
+        + "\n".join(err_lines)[-2000:]
+    )
+
+
+def _assert_wave_parity(models, params, rdirs, tokens, ids):
+    """Every token the fleet emitted for ``ids`` matches the
+    uninterrupted ``sample_fast`` stream of its ORIGINAL journaled
+    accept, computed on ``params``."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from progen_tpu.sampling import sample_fast
+
+    originals = {}
+    for rdir in rdirs:
+        for jid, acc in _journal_accepts(rdir).items():
+            pub = _public_id(jid)
+            if pub not in originals or \
+                    len(acc["prime"]) < len(originals[pub]["prime"]):
+                originals[pub] = acc
+    want = set(ids)
+    assert want <= set(originals), (sorted(want), sorted(originals))
+    refs = {}
+    for pub in want:
+        acc = originals[pub]
+        refs[pub] = np.asarray(sample_fast(
+            jnp.asarray(acc["key"], jnp.uint32),
+            models["model"], params,
+            jnp.asarray(acc["prime"], jnp.int32), acc["length"],
+            top_k=acc["top_k"], add_bos=acc["add_bos"],
+            temperature=acc["temperature"], top_p=acc["top_p"],
+        ))
+    for rid, ix, tok in tokens:
+        if rid not in want:
+            continue
+        assert refs[rid][ix] == tok, (rid, ix, tok, int(refs[rid][ix]))
+
+
+def _assert_exactly_once(out_lines, all_ids):
+    tokens, done, rejected = _parse_events(out_lines)
+    assert sorted(done) == sorted(all_ids), (sorted(done), rejected)
+    assert rejected == []
+    pairs = [(i, ix) for i, ix, _ in tokens]
+    assert len(set(pairs)) == len(pairs)
+    return tokens
+
+
+def _rollback_policy(tmp_path):
+    """Short ack timeout so a dead replica rolls the deploy back inside
+    the test budget (production default is 120s)."""
+    p = tmp_path / "deploy_policy.toml"
+    p.write_text("[deploy]\nack_timeout_s = 10.0\n")
+    return p
+
+
+def _alert_kinds(path):
+    from progen_tpu.telemetry.trace import iter_jsonl
+
+    if not Path(path).exists():
+        return []
+    return [
+        (r.get("kind"), r.get("objective"))
+        for r in iter_jsonl(path) if r.get("ev") == "alert"
+    ]
+
+
+class TestDeployKillMatrix:
+    def test_controller_sigkill_mid_promote_converges(
+        self, models, tmp_path
+    ):
+        """The tier-1 marquee case: the controller SIGKILLs entering
+        its first promote — after the canary committed the candidate
+        but before the rest of the fleet was told. A restarted
+        controller must replay the ledger and finish the rollout:
+        single fleet-wide digest, zero lost requests, bit-parity per
+        wave, compile-flat replicas."""
+        ck = tmp_path / "ck"
+        name_a = _save(ck, models["a"], 0, models["config"])
+        rdirs = [tmp_path / "r0", tmp_path / "r1"]
+        deploy_dir = tmp_path / "deploy"
+        procs = [_spawn_pinned_replica(ck, rd) for rd in rdirs]
+        router = ctrl = ctrl2 = None
+        out_lines, err_lines = [], []
+        try:
+            _wait_sockets(list(zip(procs, rdirs)))
+            router = _spawn_router(rdirs)
+            ctrl = _spawn_controller(
+                ck, rdirs, deploy_dir, chaos="deploy/promote:kill@1"
+            )
+            # adopt: the fleet baseline is pinned before any candidate
+            _wait_ledger(
+                deploy_dir,
+                lambda rs: any(r["op"] == "converged"
+                               and r["ckpt"] == name_a for r in rs),
+                120, f"adopt of {name_a}",
+            )
+            wave1 = [f"w1-{i}" for i in range(4)]
+            _send_wave(router, wave1)
+            _wait_done(router, out_lines, err_lines, wave1)
+
+            name_b = _save(ck, models["b"], 1, models["config"])
+            wave2 = [f"w2-{i}" for i in range(4)]
+            _send_wave(router, wave2, length=20)
+            # canary converts replica0, then the first promote span
+            # SIGKILLs the controller (the chaos rule firing IS the
+            # proof the kill landed mid-promote)
+            assert ctrl.wait(timeout=240) == -9
+            _wait_done(router, out_lines, err_lines, wave2)
+
+            ctrl2 = _spawn_controller(ck, rdirs, deploy_dir)
+            _wait_ledger(
+                deploy_dir,
+                lambda rs: any(r["op"] == "converged"
+                               and r["ckpt"] == name_b for r in rs),
+                240, f"resumed convergence to {name_b}",
+            )
+            wave3 = [f"w3-{i}" for i in range(4)]
+            _send_wave(router, wave3)
+            _wait_done(router, out_lines, err_lines, wave3)
+
+            router.stdin.close()
+            assert _pump(
+                router, out_lines, err_lines,
+                lambda: all(t[2] for t in router._pump_tails.values()),
+                600,
+            ), "\n".join(err_lines)[-2000:]
+            router.wait(timeout=60)
+            assert router.returncode == 0, "\n".join(err_lines)[-2000:]
+            ctrl2.terminate()
+            assert ctrl2.wait(timeout=120) == 0
+            rep_errs = [_stop_replica(p)[1] for p in procs]
+        finally:
+            for p in (router, ctrl, ctrl2):
+                if p is not None and p.poll() is None:
+                    p.kill()
+                    p.wait()
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+
+        # zero lost accepted requests, no dup tokens, nothing shed
+        all_ids = wave1 + wave2 + wave3
+        tokens = _assert_exactly_once(out_lines, all_ids)
+        # the ledger tells the full story, each step exactly once
+        ops = [r["op"] for r in _ledger(deploy_dir)]
+        assert ops.count("canary") == 1
+        assert ops.count("rollback") == 0
+        promotes = [r for r in _ledger(deploy_dir)
+                    if r["op"] == "promote"]
+        assert [p["replica"] for p in promotes] == ["replica1"]
+        # single fleet-wide digest: both acks and both live gauges on B
+        for rdir in rdirs:
+            ack = _ack_of(rdir)
+            assert ack["pin"] == name_b and \
+                ack["status"] == "committed", ack
+        for i, p in enumerate(procs):
+            assert p.returncode == 0, rep_errs[i][-2000:]
+            assert _prom_digest(rdirs[i]) == \
+                _digest_gauge_of(ck, name_b)
+            # the swap recompiled nothing on either replica
+            assert _decode_compile_count(rdirs[i]) == 1.0
+        # bit-parity: wave1 on the old weights, wave3 on the new
+        _assert_wave_parity(models, models["a"], rdirs, tokens, wave1)
+        _assert_wave_parity(models, models["b"], rdirs, tokens, wave3)
+
+    def test_canary_replica_sigkill_mid_reload_rolls_back(
+        self, models, tmp_path
+    ):
+        """The canary SIGKILLs inside its pinned reload — before the
+        candidate ever committed. The controller times out the ack,
+        rolls back, pages deploy_rollback exactly once, and the
+        candidate's weights never serve anywhere: every wave stays
+        bit-identical to the OLD weights, in-flight work on the dead
+        canary hands off to the survivor with zero loss."""
+        ck = tmp_path / "ck"
+        name_a = _save(ck, models["a"], 0, models["config"])
+        rdirs = [tmp_path / "r0", tmp_path / "r1"]
+        deploy_dir = tmp_path / "deploy"
+        alerts = tmp_path / "alerts.jsonl"
+        # replica0 (the canary) dies on its FIRST background reload —
+        # which is the canary pin (adopt is satisfied without a reload)
+        procs = [
+            _spawn_pinned_replica(ck, rdirs[0],
+                                  chaos="serve/reload:kill@1"),
+            _spawn_pinned_replica(ck, rdirs[1]),
+        ]
+        router = ctrl = None
+        out_lines, err_lines = [], []
+        try:
+            _wait_sockets(list(zip(procs, rdirs)))
+            router = _spawn_router(rdirs)
+            ctrl = _spawn_controller(
+                ck, rdirs, deploy_dir, alerts=alerts,
+                policy=_rollback_policy(tmp_path),
+            )
+            _wait_ledger(
+                deploy_dir,
+                lambda rs: any(r["op"] == "converged"
+                               and r["ckpt"] == name_a for r in rs),
+                120, f"adopt of {name_a}",
+            )
+            wave1 = [f"w1-{i}" for i in range(4)]
+            _send_wave(router, wave1)
+            _wait_done(router, out_lines, err_lines, wave1)
+
+            name_b = _save(ck, models["b"], 1, models["config"])
+            wave2 = [f"w2-{i}" for i in range(4)]
+            _send_wave(router, wave2, length=20)
+            # the canary pin lands, replica0 enters serve/reload, dies
+            assert procs[0].wait(timeout=240) == -9
+            _wait_done(router, out_lines, err_lines, wave2)
+            recs = _wait_ledger(
+                deploy_dir,
+                lambda rs: any(r["op"] == "rollback" for r in rs),
+                120, "rollback after canary death",
+            )
+            rb = [r for r in recs if r["op"] == "rollback"]
+            assert rb[0]["ckpt"] == name_b and rb[0]["to"] == name_a
+            assert rb[0]["reason"] == "canary_timeout"
+
+            wave3 = [f"w3-{i}" for i in range(4)]
+            _send_wave(router, wave3)
+            _wait_done(router, out_lines, err_lines, wave3)
+
+            # the condemned candidate is never retried: give the
+            # controller a few more ticks, then stop it gracefully
+            time.sleep(2.0)
+            ctrl.terminate()
+            assert ctrl.wait(timeout=120) == 0
+            router.stdin.close()
+            assert _pump(
+                router, out_lines, err_lines,
+                lambda: all(t[2] for t in router._pump_tails.values()),
+                600,
+            ), "\n".join(err_lines)[-2000:]
+            router.wait(timeout=60)
+            assert router.returncode == 0, "\n".join(err_lines)[-2000:]
+            _, surv_err = _stop_replica(procs[1])
+        finally:
+            for p in (router, ctrl):
+                if p is not None and p.poll() is None:
+                    p.kill()
+                    p.wait()
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+
+        all_ids = wave1 + wave2 + wave3
+        tokens = _assert_exactly_once(out_lines, all_ids)
+        ops = [r["op"] for r in _ledger(deploy_dir)]
+        assert ops.count("canary") == 1  # condemned, not retried
+        assert ops.count("rollback") == 1
+        assert "promote" not in ops  # B never left the canary
+        # exactly one page, with the condemned checkpoint as identity
+        assert _alert_kinds(alerts) == [("deploy_rollback", name_b)]
+        # the survivor stayed on A the whole time, compile-flat, and
+        # the fleet's single digest is the OLD checkpoint's
+        ack = _ack_of(rdirs[1])
+        assert ack["pin"] == name_a and ack["status"] == "committed"
+        assert procs[1].returncode == 0, surv_err[-2000:]
+        assert _prom_digest(rdirs[1]) == _digest_gauge_of(ck, name_a)
+        assert _decode_compile_count(rdirs[1]) == 1.0
+        # B never served a token: every wave is bit-identical to A —
+        # including wave2's handed-off streams from the dead canary
+        _assert_wave_parity(
+            models, models["a"], rdirs, tokens, all_ids
+        )
+
+
+@pytest.mark.slow
+class TestDeployKillMatrixSlow:
+    def test_controller_sigkill_mid_canary_resumes(
+        self, models, tmp_path
+    ):
+        """Kill the controller entering the canary span — before the
+        pin or its record exist. The restart replays an observed-only
+        ledger and runs the whole pipeline: exactly one canary record
+        total, convergence to the candidate, zero loss."""
+        ck = tmp_path / "ck"
+        name_a = _save(ck, models["a"], 0, models["config"])
+        rdirs = [tmp_path / "r0", tmp_path / "r1"]
+        deploy_dir = tmp_path / "deploy"
+        procs = [_spawn_pinned_replica(ck, rd) for rd in rdirs]
+        router = ctrl = ctrl2 = None
+        out_lines, err_lines = [], []
+        try:
+            _wait_sockets(list(zip(procs, rdirs)))
+            router = _spawn_router(rdirs)
+            ctrl = _spawn_controller(
+                ck, rdirs, deploy_dir, chaos="deploy/canary:kill@1"
+            )
+            _wait_ledger(
+                deploy_dir,
+                lambda rs: any(r["op"] == "converged"
+                               and r["ckpt"] == name_a for r in rs),
+                120, f"adopt of {name_a}",
+            )
+            wave1 = [f"w1-{i}" for i in range(4)]
+            _send_wave(router, wave1)
+            _wait_done(router, out_lines, err_lines, wave1)
+
+            name_b = _save(ck, models["b"], 1, models["config"])
+            wave2 = [f"w2-{i}" for i in range(4)]
+            _send_wave(router, wave2, length=20)
+            assert ctrl.wait(timeout=240) == -9  # died entering canary
+
+            ctrl2 = _spawn_controller(ck, rdirs, deploy_dir)
+            _wait_ledger(
+                deploy_dir,
+                lambda rs: any(r["op"] == "converged"
+                               and r["ckpt"] == name_b for r in rs),
+                240, f"resumed convergence to {name_b}",
+            )
+            _wait_done(router, out_lines, err_lines, wave2)
+            wave3 = [f"w3-{i}" for i in range(4)]
+            _send_wave(router, wave3)
+            _wait_done(router, out_lines, err_lines, wave3)
+            router.stdin.close()
+            assert _pump(
+                router, out_lines, err_lines,
+                lambda: all(t[2] for t in router._pump_tails.values()),
+                600,
+            ), "\n".join(err_lines)[-2000:]
+            router.wait(timeout=60)
+            assert router.returncode == 0
+            ctrl2.terminate()
+            assert ctrl2.wait(timeout=120) == 0
+            rep_errs = [_stop_replica(p)[1] for p in procs]
+        finally:
+            for p in (router, ctrl, ctrl2):
+                if p is not None and p.poll() is None:
+                    p.kill()
+                    p.wait()
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+
+        all_ids = wave1 + wave2 + wave3
+        tokens = _assert_exactly_once(out_lines, all_ids)
+        ops = [r["op"] for r in _ledger(deploy_dir)]
+        assert ops.count("canary") == 1
+        assert ops.count("rollback") == 0
+        for rdir in rdirs:
+            ack = _ack_of(rdir)
+            assert ack["pin"] == name_b and \
+                ack["status"] == "committed"
+        for i, p in enumerate(procs):
+            assert p.returncode == 0, rep_errs[i][-2000:]
+            assert _decode_compile_count(rdirs[i]) == 1.0
+        _assert_wave_parity(models, models["a"], rdirs, tokens, wave1)
+        _assert_wave_parity(models, models["b"], rdirs, tokens, wave3)
+
+    def test_follower_replica_sigkill_mid_promote_rolls_back(
+        self, models, tmp_path
+    ):
+        """A NON-canary replica dies inside its promote reload. The
+        promote ack times out, the rollback re-pins the canary back to
+        the fleet checkpoint (it had already committed the candidate),
+        and the surviving fleet converges on the OLD digest."""
+        ck = tmp_path / "ck"
+        name_a = _save(ck, models["a"], 0, models["config"])
+        rdirs = [tmp_path / "r0", tmp_path / "r1"]
+        deploy_dir = tmp_path / "deploy"
+        alerts = tmp_path / "alerts.jsonl"
+        # replica1's FIRST reload is its promote pin — die inside it
+        procs = [
+            _spawn_pinned_replica(ck, rdirs[0]),
+            _spawn_pinned_replica(ck, rdirs[1],
+                                  chaos="serve/reload:kill@1"),
+        ]
+        router = ctrl = None
+        out_lines, err_lines = [], []
+        try:
+            _wait_sockets(list(zip(procs, rdirs)))
+            router = _spawn_router(rdirs)
+            ctrl = _spawn_controller(
+                ck, rdirs, deploy_dir, alerts=alerts,
+                policy=_rollback_policy(tmp_path),
+            )
+            _wait_ledger(
+                deploy_dir,
+                lambda rs: any(r["op"] == "converged"
+                               and r["ckpt"] == name_a for r in rs),
+                120, f"adopt of {name_a}",
+            )
+            wave1 = [f"w1-{i}" for i in range(4)]
+            _send_wave(router, wave1)
+            _wait_done(router, out_lines, err_lines, wave1)
+
+            name_b = _save(ck, models["b"], 1, models["config"])
+            wave2 = [f"w2-{i}" for i in range(4)]
+            _send_wave(router, wave2, length=20)
+            # canary commits B, promote pins replica1, replica1 dies
+            assert procs[1].wait(timeout=240) == -9
+            _wait_done(router, out_lines, err_lines, wave2)
+            recs = _wait_ledger(
+                deploy_dir,
+                lambda rs: any(r["op"] == "rollback" for r in rs),
+                120, "rollback after follower death",
+            )
+            rb = [r for r in recs if r["op"] == "rollback"][0]
+            assert rb["reason"] == "promote_timeout:replica1"
+            # the canary swings BACK to the fleet checkpoint
+            _wait_ack(rdirs[0], name_a)
+
+            wave3 = [f"w3-{i}" for i in range(4)]
+            _send_wave(router, wave3)
+            _wait_done(router, out_lines, err_lines, wave3)
+            ctrl.terminate()
+            assert ctrl.wait(timeout=120) == 0
+            router.stdin.close()
+            assert _pump(
+                router, out_lines, err_lines,
+                lambda: all(t[2] for t in router._pump_tails.values()),
+                600,
+            ), "\n".join(err_lines)[-2000:]
+            router.wait(timeout=60)
+            assert router.returncode == 0
+            _, surv_err = _stop_replica(procs[0])
+        finally:
+            for p in (router, ctrl):
+                if p is not None and p.poll() is None:
+                    p.kill()
+                    p.wait()
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+
+        all_ids = wave1 + wave2 + wave3
+        tokens = _assert_exactly_once(out_lines, all_ids)
+        assert _alert_kinds(alerts) == [("deploy_rollback", name_b)]
+        # the surviving fleet's single digest is the OLD checkpoint
+        ack = _ack_of(rdirs[0])
+        assert ack["pin"] == name_a and ack["status"] == "committed"
+        assert procs[0].returncode == 0, surv_err[-2000:]
+        assert _prom_digest(rdirs[0]) == _digest_gauge_of(ck, name_a)
+        # wave1 ran on A before the deploy; wave3 on A after the
+        # rollback settled. wave2 rode the canary's A->B->A swing:
+        # exactly-once settlement only.
+        _assert_wave_parity(models, models["a"], rdirs, tokens, wave1)
+        _assert_wave_parity(models, models["a"], rdirs, tokens, wave3)

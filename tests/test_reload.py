@@ -274,6 +274,140 @@ class TestRejectionPaths:
         np.testing.assert_array_equal(before, _first_leaf(engine.params))
 
 
+class TestPins:
+    """The deploy controller's per-replica seam: a ``reload.pin``
+    control file overrides newest-wins watching, and every pin outcome
+    is answered through the adjacent ``reload.pin.ack``."""
+
+    def _fleet_of_two(self, tmp_path, model_and_params):
+        """Checkpoints A and B on disk, engine serving B (the newest)."""
+        model, params = model_and_params
+        ck = tmp_path / "ck"
+        name_a = _ckpt_name(_save(ck, params))
+        params_b = jax.tree.map(lambda x: x * 1.5, params)
+        name_b = _ckpt_name(_save(ck, params_b, step=1))
+        engine = ServeEngine(model, params_b, max_slots=2, max_len=24)
+        pin_path = tmp_path / "reload.pin"
+        reloader = WeightReloader(
+            engine, ck, current=name_b, pin_path=pin_path
+        )
+        return ck, name_a, name_b, engine, pin_path, reloader
+
+    def _ack(self, pin_path):
+        ack = pin_path.with_name(pin_path.name + ".ack")
+        import json
+
+        return json.loads(ack.read_text())
+
+    def test_pin_to_older_checkpoint_commits_and_acks(
+        self, tmp_path, model_and_params
+    ):
+        """A pin is not 'newest-wins': the controller can roll a replica
+        BACK to an older verified checkpoint by name."""
+        _, params = model_and_params
+        ck, name_a, name_b, engine, pin_path, reloader = \
+            self._fleet_of_two(tmp_path, model_and_params)
+        pin_path.write_text(name_a + "\n")
+        assert reloader.poll_watch(0.0) is True
+        reloader.join(120)
+        assert reloader.maybe_commit() == name_a
+        assert reloader.current == name_a
+        np.testing.assert_array_equal(
+            _first_leaf(engine.params), _first_leaf(params)
+        )
+        ack = self._ack(pin_path)
+        assert ack["pin"] == name_a and ack["status"] == "committed"
+
+    def test_pin_to_missing_name_rejected_weights_untouched(
+        self, tmp_path, model_and_params
+    ):
+        ck, name_a, name_b, engine, pin_path, reloader = \
+            self._fleet_of_two(tmp_path, model_and_params)
+        before = _first_leaf(engine.params).copy()
+        pin_path.write_text("ckpt_99999999\n")
+        assert reloader.poll_watch(0.0) is True
+        reloader.join(120)
+        assert reloader.maybe_commit() is None
+        assert reloader.last_error == "pin_unavailable"
+        assert reloader.current == name_b
+        np.testing.assert_array_equal(before, _first_leaf(engine.params))
+        ack = self._ack(pin_path)
+        assert ack["pin"] == "ckpt_99999999"
+        assert ack["status"] == "rejected"
+        assert ack["reason"] == "pin_unavailable"
+
+    def test_rejected_pin_not_retried_until_it_changes(
+        self, tmp_path, model_and_params
+    ):
+        """No hot retry loop on a pin that keeps failing — the watcher
+        re-attempts only when the controller writes a different name."""
+        ck, name_a, name_b, engine, pin_path, reloader = \
+            self._fleet_of_two(tmp_path, model_and_params)
+        pin_path.write_text("ckpt_99999999\n")
+        assert reloader.poll_watch(0.0) is True
+        reloader.join(120)
+        assert reloader.maybe_commit() is None
+        assert reloader.poll_watch(0.0) is False  # same bad pin: no kick
+        pin_path.write_text(name_a + "\n")  # rollback to a real one
+        assert reloader.poll_watch(0.0) is True
+        reloader.join(120)
+        assert reloader.maybe_commit() == name_a
+
+    def test_pin_overrides_newest_wins(self, tmp_path, model_and_params):
+        """While the canary bakes, the rest of the fleet is pinned to
+        the fleet checkpoint: a newer dir on disk must NOT be loaded."""
+        _, params = model_and_params
+        ck, name_a, name_b, engine, pin_path, reloader = \
+            self._fleet_of_two(tmp_path, model_and_params)
+        # a newer checkpoint appears, but the pin says stay on B
+        _save(ck, jax.tree.map(lambda x: x + 1.0, params), step=2)
+        pin_path.write_text(name_b + "\n")
+        assert reloader.poll_watch(0.0) is False
+        assert reloader.current == name_b
+        # the already-satisfied pin is still answered (the controller
+        # needs the ack even when no reload was necessary)
+        ack = self._ack(pin_path)
+        assert ack["pin"] == name_b and ack["status"] == "committed"
+
+    def test_pin_removal_resumes_newest_wins(
+        self, tmp_path, model_and_params
+    ):
+        _, params = model_and_params
+        ck, name_a, name_b, engine, pin_path, reloader = \
+            self._fleet_of_two(tmp_path, model_and_params)
+        pin_path.write_text(name_b + "\n")
+        assert reloader.poll_watch(0.0) is False  # pinned in place
+        name_c = _ckpt_name(
+            _save(ck, jax.tree.map(lambda x: x + 1.0, params), step=2)
+        )
+        assert reloader.poll_watch(0.0) is False  # still pinned
+        pin_path.unlink()
+        assert reloader.poll_watch(0.0) is True  # back to newest-wins
+        reloader.join(120)
+        assert reloader.maybe_commit() == name_c
+
+    def test_startup_pin_answered_without_reload(
+        self, tmp_path, model_and_params
+    ):
+        """A pin file that predates the process: committed when startup
+        restored exactly the pinned checkpoint, rejected when it had to
+        fall back — the controller must never wait forever."""
+        ck, name_a, name_b, engine, pin_path, reloader = \
+            self._fleet_of_two(tmp_path, model_and_params)
+        pin_path.write_text(name_b + "\n")
+        reloader.note_startup_pin()
+        ack = self._ack(pin_path)
+        assert ack["pin"] == name_b and ack["status"] == "committed"
+
+        pin_path.write_text("ckpt_99999999\n")
+        reloader.note_startup_pin()
+        ack = self._ack(pin_path)
+        assert ack["status"] == "rejected"
+        assert ack["reason"] == "pin_unavailable_at_startup"
+        # and the watcher will not hot-retry the startup rejection
+        assert reloader.poll_watch(0.0) is False
+
+
 class TestWatcher:
     def test_poll_watch_kicks_on_new_checkpoint(
         self, tmp_path, model_and_params

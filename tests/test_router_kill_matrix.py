@@ -346,12 +346,20 @@ def _run_fleet(workspace, tmp_path, *, replica_chaos=(), router_chaos="",
         for p in procs:
             if p.poll() is None:
                 p.terminate()
+                p._sigterm_sent = True
 
 
 def _stop_replica(proc, timeout_s=120):
-    """Graceful SIGTERM drain; returns (stdout, stderr)."""
-    if proc.poll() is None:
+    """Graceful SIGTERM drain; returns (stdout, stderr).
+
+    One SIGTERM only: serve treats a second one as "exit now" (and a
+    replica caught between drain and exit dies -15), so a process that
+    ``_run_fleet`` already signalled is only waited on, never
+    re-signalled — the drain it is running IS the graceful stop.
+    """
+    if proc.poll() is None and not getattr(proc, "_sigterm_sent", False):
         proc.terminate()
+    proc._sigterm_sent = True
     return proc.communicate(timeout=timeout_s)
 
 
