@@ -194,6 +194,11 @@ _PHASES = (
     # (pinned to CPU: host-side transport parity, no chip claim) — the
     # gated serve_transport_parity ratio
     ("transport-overhead", 600),
+    # armed vs disarmed flight recorder on real serve subprocesses
+    # (pinned to CPU: host-side forensics parity, no chip claim) — the
+    # gated flight_overhead_ratio; the always-on black box must stay
+    # within ~1% of free
+    ("flight-overhead", 600),
     # int8 weight-quantized decode vs fp on the same params (quant
     # compile cost rides the engine build; two decode jits total)
     ("decode-int8", 600),
@@ -1836,6 +1841,213 @@ def _transport_overhead_safe() -> dict:
         return {"phase": "transport-overhead", "error": repr(e)[:300]}
 
 
+def _flight_overhead_bench() -> dict:
+    """Armed vs disarmed flight recorder on real serving: the cost of
+    the always-on black box (progen_tpu/telemetry/flight.py — an
+    EMIT_TAPS hook that appends every telemetry record into a bounded
+    in-memory ring) on the two client-visible numbers, streamed
+    tokens/s and decode ITL p99.
+
+    Two REAL ``cli/serve`` subprocesses (smoke shapes, pinned to CPU so
+    the phase never fights the suite's chip claim) serve the identical
+    request set over a unix socket — once with ``--flight_dir`` armed,
+    once without — with one warmup request paying the compile outside
+    each measured window. Model compute and transport are identical on
+    both sides, so the ratios isolate the tap. Headline ``value`` =
+    min(armed/disarmed tokens-per-sec ratio, disarmed/armed ITL-p99
+    ratio) — the conservative parity number, ~1.0 when the recorder is
+    free; the forensics contract is that it stays within ~1% of free,
+    and the bench gate ratchets it (``--metric flight_overhead_ratio``).
+    Host-side by construction: honest on any runner, which is why
+    tier1.yml can enforce it."""
+    import select
+    import signal as _signal
+    import socket
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from flax.core import meta
+
+    from progen_tpu.checkpoint import Package, get_checkpoint_fns
+    from progen_tpu.config import ProGenConfig
+    from progen_tpu.models.progen import ProGen
+
+    n_requests = 8
+    gen_length = 24
+    config = ProGenConfig(
+        num_tokens=256, dim=32, seq_len=32, depth=2, window_size=8,
+        global_mlp_depth=1, heads=2, dim_head=16, ff_mult=2,
+        dtype="float32",
+    )
+
+    def _measure(side, armed, root, ck):
+        """One serve subprocess + one unix-socket client; returns
+        tokens/s, ITL p99, and the (id -> [(index, token)]) streams."""
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PROGEN_CHAOS", None)
+        env["PYTHONPATH"] = f"{_REPO}{os.pathsep}" + env.get(
+            "PYTHONPATH", ""
+        )
+        spath = str(root / f"{side}.sock")
+        args = [
+            sys.executable, "-m", "progen_tpu.cli.serve",
+            "--checkpoint_path", str(ck),
+            "--max-slots", "4", "--max-queue", "32", "--max-len", "32",
+            "--journal_dir", str(root / f"jd_{side}"),
+            "--socket", spath,
+        ]
+        if armed:
+            args += ["--flight_dir", str(root / f"flight_{side}")]
+        err_path = root / f"{side}.err"
+        proc = subprocess.Popen(
+            args, stdout=subprocess.DEVNULL,
+            stderr=open(err_path, "w"), env=env,
+        )
+        try:
+            deadline = time.time() + 180
+            while time.time() < deadline and not os.path.exists(spath):
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"serve died: {err_path.read_text()[-2000:]}"
+                    )
+                time.sleep(0.2)
+            if not os.path.exists(spath):
+                raise RuntimeError(f"{side} serve never listened")
+
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            sock.connect(spath)
+            state = {"buf": b""}
+
+            def send_req(obj):
+                sock.sendall(json.dumps(obj).encode() + b"\n")
+
+            def pump_until_done(want, timeout_s):
+                events, got = [], set()
+                stop = time.time() + timeout_s
+                while time.time() < stop and not want <= got:
+                    r, _, _ = select.select([sock], [], [], 0.5)
+                    if not r:
+                        continue
+                    data = sock.recv(65536)
+                    if not data:
+                        break
+                    state["buf"] += data
+                    *full, state["buf"] = state["buf"].split(b"\n")
+                    now = time.perf_counter()
+                    for raw in full:
+                        if not raw.strip():
+                            continue
+                        ev = json.loads(raw)
+                        ev["_t"] = now
+                        events.append(ev)
+                        if ev.get("event") == "done":
+                            got.add(ev["id"])
+                if not want <= got:
+                    raise RuntimeError(
+                        f"{side}: undone after {timeout_s}s: "
+                        f"{sorted(want - got)}"
+                    )
+                return events
+
+            t0 = time.perf_counter()
+            send_req({"id": "warm", "prime": "MKV", "length": 12,
+                      "seed": 1})
+            pump_until_done({"warm"}, 300)
+            compile_s = time.perf_counter() - t0
+            _mark(f"flight {side}: warm in {compile_s:.1f}s")
+
+            submits = {}
+            for i in range(n_requests):
+                rid = f"r{i}"
+                submits[rid] = time.perf_counter()
+                send_req({"id": rid, "prime": "MKV",
+                          "length": gen_length, "seed": 70 + i})
+            events = pump_until_done(set(submits), 300)
+
+            arrivals, streams, n_tokens = {}, {}, 0
+            for ev in events:
+                if ev.get("event") != "token":
+                    continue
+                n_tokens += 1
+                arrivals.setdefault(ev["id"], []).append(ev["_t"])
+                streams.setdefault(ev["id"], []).append(
+                    (ev["index"], ev["token"])
+                )
+            wall = max(ev["_t"] for ev in events) - min(submits.values())
+            itl = [
+                b - a
+                for ts in arrivals.values()
+                for a, b in zip(ts, ts[1:])
+                if b > a  # same-recv batches carry one stamp
+            ]
+            sock.close()
+            return {
+                "tokens_per_sec": n_tokens / max(wall, 1e-9),
+                "itl_p99_s": (
+                    float(np.percentile(itl, 99)) if itl else 0.0
+                ),
+                "tokens": n_tokens,
+                "streams": streams,
+                "compile_s": compile_s,
+            }
+        finally:
+            if proc.poll() is None:
+                proc.send_signal(_signal.SIGTERM)  # graceful drain
+                try:
+                    proc.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait(timeout=10)
+
+    with tempfile.TemporaryDirectory() as td:
+        root = Path(td)
+        model = ProGen(config)
+        variables = model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, config.seq_len), jnp.int32),
+        )
+        params = meta.unbox(variables)["params"]
+        _, _, save = get_checkpoint_fns(str(root / "ck"))
+        save(Package(0, {"params": params}, config.to_dict(),
+                     "flight-bench"))
+        _mark(f"flight: checkpoint saved, {n_requests} reqs/side")
+
+        # interleave-free A/B: disarmed first (the baseline), then armed
+        off = _measure("disarmed", False, root, root / "ck")
+        on = _measure("armed", True, root, root / "ck")
+
+    tps_ratio = on["tokens_per_sec"] / max(off["tokens_per_sec"], 1e-9)
+    itl_ratio = off["itl_p99_s"] / max(on["itl_p99_s"], 1e-9)
+    value = min(tps_ratio, itl_ratio)
+    _mark(f"flight: tps_ratio={tps_ratio:.3f} itl_ratio={itl_ratio:.3f}")
+    return {
+        "phase": "flight-overhead",
+        "metric": "flight_overhead_ratio",
+        "value": round(value, 3),
+        "host_side": True,
+        "timing_suspect": False,
+        "config": "smoke-serve32",
+        "n_requests": n_requests,
+        "tokens_per_sec_ratio": round(tps_ratio, 3),
+        "itl_p99_ratio": round(itl_ratio, 3),
+        "disarmed_tokens_per_sec": round(off["tokens_per_sec"], 1),
+        "armed_tokens_per_sec": round(on["tokens_per_sec"], 1),
+        "disarmed_itl_p99_s": round(off["itl_p99_s"], 5),
+        "armed_itl_p99_s": round(on["itl_p99_s"], 5),
+        # the ring tap must not touch the sampled streams: same seeds,
+        # same tokens, bit for bit
+        "bit_identical": on["streams"] == off["streams"],
+        "compile_s": {
+            "disarmed": round(off["compile_s"], 1),
+            "armed": round(on["compile_s"], 1),
+        },
+        "platform": "host",
+    }
+
+
 def _decode_int8_bench() -> dict:
     """Int8 weight-quantized decode (ops/quant.py, --int8 on the serve
     CLI) vs the full-precision engine built from the SAME params: decode
@@ -2300,6 +2512,8 @@ def run_phase(name: str) -> dict:
         return _decode_admit_stall_bench()
     if name == "transport-overhead":
         return _transport_overhead_bench()
+    if name == "flight-overhead":
+        return _flight_overhead_bench()
     if name == "decode-int8":
         return _decode_int8_bench()
     if name == "batch-score":
@@ -2604,6 +2818,14 @@ def main() -> None:
             # same carry idiom: keep the transport record on the chain
             # even in rounds whose parsed metric is the train number
             headline["serve_transport_parity"] = res["value"]
+        elif ph == "flight-overhead":
+            summary[ph] = {
+                "parity": res["value"],
+                "bit_identical": res["bit_identical"],
+            }
+            # same carry idiom: keep the forensics record on the chain
+            # even in rounds whose parsed metric is the train number
+            headline["flight_overhead_ratio"] = res["value"]
         elif ph == "decode-int8":
             summary[ph] = {
                 "int8_tps": res["int8_tokens_per_sec"],

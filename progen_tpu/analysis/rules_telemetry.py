@@ -97,6 +97,17 @@ dashboard query then matches nothing. Three checks:
     shipper's proof a block's digest was verified into the archive
     manifest; a literal ``op`` must come from the ``shipped``/
     ``skipped``/``verify_failed`` alphabet.
+  * raw ``"ev": "flight"`` records must not be emitted outside
+    ``telemetry/flight.py`` — a ``dumped`` record is the flight
+    recorder's receipt that a sealed, digest-valid black box reached
+    disk (the forensics smoke and ``query --trace`` key on it); a
+    literal ``op`` must come from the ``armed``/``dumped``/
+    ``truncated`` alphabet.
+  * raw ``"ev": "profile"`` records must not be emitted outside
+    ``telemetry/flight.py`` — the profile pin ledger pairs
+    ``requested`` with ``started``/``stopped`` (or ``rejected``) so
+    an on-demand ``jax.profiler`` window is provably bounded and
+    rate-limited; a literal ``op`` must come from that alphabet.
   * ``"ev": "deploy"`` dict literals (deployment decisions) may only
     be built in ``progen_tpu/deploy/`` — the deploy ledger is the
     controller's resume authority, and a hand-rolled record forges a
@@ -492,6 +503,41 @@ class TelemetryHygieneRule(Rule):
                     "slo record 'state'",
                     "the gate's exit-code contract and the transition "
                     "grammar only know these states",
+                )
+            elif v.value == "flight":
+                if not self._in_module("telemetry/flight.py"):
+                    self.report(
+                        v,
+                        "raw flight record emitted outside "
+                        "telemetry/flight.py — a 'dumped' record is the "
+                        "recorder's receipt that a sealed, digest-valid "
+                        "black box reached disk; a hand-rolled one "
+                        "claims forensic evidence that was never "
+                        "written; go through FlightRecorder",
+                    )
+                self._check_literal_member(
+                    d, "op", ("armed", "dumped", "truncated"),
+                    "flight record 'op'",
+                    "the forensics smoke and query --trace grep "
+                    "exactly the armed/dumped/truncated op set",
+                )
+            elif v.value == "profile":
+                if not self._in_module("telemetry/flight.py"):
+                    self.report(
+                        v,
+                        "raw profile record emitted outside "
+                        "telemetry/flight.py — the pin watcher's "
+                        "request/ack ledger is the proof a jax.profiler "
+                        "window actually ran (and was rate-limited); go "
+                        "through request_profile/ProfilePinWatcher",
+                    )
+                self._check_literal_member(
+                    d, "op",
+                    ("requested", "started", "stopped", "rejected"),
+                    "profile record 'op'",
+                    "the on-demand profiling smoke pairs requested/"
+                    "started/stopped and triages rejected — an unknown "
+                    "op is an invisible window",
                 )
             elif not _PROM_NAME_RE.match(v.value):
                 self.report(

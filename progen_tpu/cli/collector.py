@@ -109,6 +109,25 @@ from progen_tpu.telemetry.tsdb import BlockShipper, RingTSDB
          "manifest) before the ring downsamples or drops them",
 )
 @click.option(
+    "--flight-dir", "flight_dir",
+    type=click.Path(file_okay=False), default=None,
+    help="arm the collector's flight recorder: bounded ring of recent "
+         "scrape/SLO telemetry, dumped atomically here on crash paths "
+         "and on fleet SLO 'burning' edges",
+)
+@click.option(
+    "--profile-pin", "profile_pins", multiple=True,
+    help="on the first fleet SLO 'burning' edge, request an on-demand "
+         "jax.profiler window by writing this control file (a serve/"
+         "train --profile_pin path) — repeatable, rate-limited",
+)
+@click.option(
+    "--profile-min-interval", type=float, default=300.0,
+    show_default=True,
+    help="seconds between auto-requested profile windows (per "
+         "collector, across all pins)",
+)
+@click.option(
     "--max-ticks", type=int, default=0, show_default=True,
     help="stop after N scrapes (0 = run until SIGTERM/SIGINT)",
 )
@@ -118,7 +137,9 @@ from progen_tpu.telemetry.tsdb import BlockShipper, RingTSDB
 def main(
     tsdb_dir, source_specs, config_path, interval, stale_after,
     budget_bytes, block_bytes, slo_path, alerts_out,
-    remote_write_url, alert_config_path, archive_dir, max_ticks, once,
+    remote_write_url, alert_config_path, archive_dir,
+    flight_dir, profile_pins, profile_min_interval,
+    max_ticks, once,
 ):
     """Scrape fleet metrics sources into a bounded TSDB + alert sink."""
     settings = {}
@@ -182,7 +203,12 @@ def main(
     coll = Collector(
         tsdb, sources, stale_after_s=stale_after,
         slo_cfg=cfg, alerts=alerts, remote_write=bridge,
+        profile_pins=profile_pins,
+        profile_min_interval_s=profile_min_interval,
     )
+    from progen_tpu.telemetry import flight as flight_mod
+    if flight_dir:
+        flight_mod.arm(flight_dir)
     click.echo(
         f"collector: {len(sources)} sources -> {tsdb.root} "
         f"(every {interval:g}s, stale after {stale_after:g}s, "
@@ -191,6 +217,9 @@ def main(
         + (f", remote-write {remote_write_url}" if bridge else "")
         + (f", {len(router.routes)} alert routes" if router else "")
         + (f", archive {archive_dir}" if shipper else "")
+        + (f", flight {flight_dir}" if flight_dir else "")
+        + (f", auto-profile x{len(profile_pins)}"
+           if profile_pins else "")
         + ")",
         err=True,
     )
@@ -216,6 +245,7 @@ def main(
             while not stop["flag"] and time.time() < deadline:
                 time.sleep(min(0.2, interval))
     finally:
+        flight_mod.disarm()
         tsdb.close()
         alerts.close()
         if router is not None:

@@ -79,11 +79,16 @@ import click
               help="tick cadence in seconds (overrides the policy)")
 @click.option("--once", is_flag=True, default=False,
               help="one tick, then exit (smoke/CI)")
+@click.option("--flight_dir", default=None, type=str,
+              help="arm the deploy controller's flight recorder: "
+                   "bounded ring of recent deploy telemetry, dumped "
+                   "atomically here on crash paths and on anomaly "
+                   "rollback")
 @click.option("--max_ticks", default=0,
               help="exit after N ticks (0 = run until signalled)")
 def main(checkpoint_path, fleet_dir, replica_specs, deploy_dir,
          probe_fasta, policy_path, tsdb, alerts_path, alert_config,
-         canary, interval, once, max_ticks):
+         canary, interval, once, flight_dir, max_ticks):
     import dataclasses
 
     from progen_tpu import telemetry
@@ -159,6 +164,9 @@ def main(checkpoint_path, fleet_dir, replica_specs, deploy_dir,
 
     tracker = make_tracker("progen-deploy")
     telemetry.configure(sink=tracker.log_event)
+    from progen_tpu.telemetry import flight as flight_mod
+    if flight_dir:
+        flight_mod.arm(flight_dir)
     ctrl = DeployController(
         checkpoint_path, replicas, deploy_dir, policy,
         probe_fasta=probe_fasta, reader=reader, alerts=alerts,
@@ -207,6 +215,7 @@ def main(checkpoint_path, fleet_dir, replica_specs, deploy_dir,
             alerts.close()
         if router is not None:
             router.close()
+        flight_mod.disarm()
         telemetry.configure()  # detach before the sink closes
         tracker.finish()
     click.echo(

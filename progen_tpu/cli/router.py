@@ -101,6 +101,17 @@ import click
                    "FLEET_DIR/replica{i}/reload.pin control file "
                    "(serve --reload_pin) — the deploy controller's "
                    "per-replica seam (0 = off)")
+@click.option("--replica_profile_watch", default=False, is_flag=True,
+              help="spawned replicas watch "
+                   "FLEET_DIR/replica{i}/profile.pin (serve "
+                   "--profile_pin) for on-demand jax.profiler windows "
+                   "and arm their flight recorders (dumps to "
+                   "replica{i}/flight/) — the collector's auto-profile "
+                   "and crash-forensics seam, per replica")
+@click.option("--flight_dir", default=None, type=str,
+              help="arm the ROUTER's own flight recorder: bounded ring "
+                   "of recent routing telemetry, dumped atomically here "
+                   "on crash paths")
 @click.option("--max-queue", default=256,
               help="router admission queue bound (shed reason "
                    "'router_queue_full' beyond it)")
@@ -133,7 +144,8 @@ import click
                    "localhost port (0 = off)")
 def main(replica_specs, spawn, checkpoint_path, fleet_dir, respawn,
          replica_max_slots, replica_max_queue, max_len,
-         replica_reload_watch, max_queue, tenant_quota,
+         replica_reload_watch, replica_profile_watch, flight_dir,
+         max_queue, tenant_quota,
          heartbeat_timeout, socket_path, listen_tcp,
          autoscale_policy, autoscale_tsdb, metrics_every,
          prom_file, prom_port):
@@ -184,6 +196,11 @@ def main(replica_specs, spawn, checkpoint_path, fleet_dir, respawn,
                 "--reload_watch", str(replica_reload_watch),
                 "--reload_pin", os.path.join(rdir, "reload.pin"),
             ]
+        if replica_profile_watch:
+            args += [
+                "--profile_pin", os.path.join(rdir, "profile.pin"),
+                "--flight_dir", os.path.join(rdir, "flight"),
+            ]
         if replay:
             args += ["--replay", rdir]
         log = open(os.path.join(rdir, "replica.log"), "ab")
@@ -219,6 +236,9 @@ def main(replica_specs, spawn, checkpoint_path, fleet_dir, respawn,
     )
     tracker = make_tracker("progen-router")
     telemetry.configure(sink=tracker.log_event)
+    from progen_tpu.telemetry import flight as flight_mod
+    if flight_dir:
+        flight_mod.arm(flight_dir, metrics_fn=router.metrics.snapshot)
     run_dir = getattr(tracker, "path", None)
     if run_dir is not None:
         print(
@@ -357,6 +377,7 @@ def main(replica_specs, spawn, checkpoint_path, fleet_dir, respawn,
                 router.close_tracks("killed")
             except Exception:
                 pass  # a torn trace line beats a hung exit
+            flight_mod.dump_now("killed", note=f"signal {signum}")
             sys.stderr.flush()
             os._exit(1)
         shutdown["flag"] = True
@@ -416,6 +437,7 @@ def main(replica_specs, spawn, checkpoint_path, fleet_dir, respawn,
             except subprocess.TimeoutExpired:
                 proc.kill()
             log.close()
+        flight_mod.disarm()
         telemetry.configure()  # detach before the sink closes
         tracker.finish()
 

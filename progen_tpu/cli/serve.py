@@ -350,11 +350,27 @@ def _build(checkpoint_path, max_slots, max_len, max_queue,
                    "checkpoint is restored directly. The deploy "
                    "controller's canary/promote seam. Implies "
                    "--reload_watch 2 when unset")
+@click.option("--flight_dir", default=None, type=str,
+              help="arm the flight recorder: keep the last "
+                   "events/spans/requests in a bounded in-memory ring "
+                   "and dump an atomic flight-<host>-<ts>.json here on "
+                   "crash paths (chaos kill, stall escalation, "
+                   "unhandled exception, second kill signal)")
+@click.option("--profile_pin", "profile_pin_path", default=None, type=str,
+              help="profile.pin control file: when it carries a token "
+                   "(optionally '<token> <seconds>'), start a bounded "
+                   "jax.profiler trace window on the live process and "
+                   "answer through FILE.ack — no restart. Polled every "
+                   "2s between decode steps")
+@click.option("--profile_out", default=None, type=str,
+              help="directory for on-demand profiler trace windows "
+                   "(default: <profile_pin dir>/profiles)")
 def main(checkpoint_path, max_slots, max_queue, max_len, quantize_int8,
          prefill_chunk, prefix_cache_mb, top_k, temperature, top_p, seed,
          socket_path, tcp_hostport, idle_timeout, metrics_every,
          prom_file, prom_port, heartbeat, journal_dir, replay_dir,
-         reload_watch, reload_pin_path):
+         reload_watch, reload_pin_path, flight_dir, profile_pin_path,
+         profile_out):
     from progen_tpu import telemetry
     from progen_tpu.resilience.chaos import install_from_env
     from progen_tpu.telemetry import (
@@ -406,6 +422,25 @@ def main(checkpoint_path, max_slots, max_queue, max_len, quantize_int8,
             "(render with progen-tpu-telemetry export-trace)",
             file=sys.stderr,
         )
+
+    # forensics: black-box ring + on-demand profiler window, both armed
+    # only when asked — the flight-overhead bench pins the armed cost
+    from progen_tpu.telemetry import flight as flight_mod
+
+    if flight_dir:
+        flight_mod.arm(flight_dir, metrics_fn=sched.metrics.snapshot)
+        print(f"flight recorder armed: dumps to {flight_dir}",
+              file=sys.stderr)
+    prof_watcher = None
+    if profile_pin_path:
+        prof_out = profile_out or os.path.join(
+            os.path.dirname(profile_pin_path) or ".", "profiles"
+        )
+        prof_watcher = flight_mod.ProfilePinWatcher(
+            profile_pin_path, prof_out
+        )
+        print(f"profile pin watched: {profile_pin_path} "
+              f"(windows to {prof_out})", file=sys.stderr)
 
     import time as _time
 
@@ -489,6 +524,8 @@ def main(checkpoint_path, max_slots, max_queue, max_len, quantize_int8,
                       file=sys.stderr)
         if reload_watch:
             reloader.poll_watch(reload_watch)
+        if prof_watcher is not None:
+            prof_watcher.poll_watch()
         name = reloader.maybe_commit()
         if name is not None:
             ckd["name"], ckd["gauge"] = name, _digest_of(name)
@@ -547,6 +584,9 @@ def main(checkpoint_path, max_slots, max_queue, max_len, quantize_int8,
                 sched.close_tracks("killed")
             except Exception:
                 pass  # a torn trace line beats a hung exit
+            # last act: the black box (atomic — a kill mid-dump leaves
+            # no torn file, and dump_now never raises)
+            flight_mod.dump_now("killed", note=f"signal {signum}")
             sys.stderr.flush()
             os._exit(1)
         shutdown["flag"] = True
@@ -586,6 +626,9 @@ def main(checkpoint_path, max_slots, max_queue, max_len, quantize_int8,
         )
         if prom_srv is not None:
             prom_srv.shutdown()
+        if prof_watcher is not None:
+            prof_watcher.close()  # flush an in-flight profiler window
+        flight_mod.disarm()
         telemetry.configure()  # detach before the sink closes
         tracker.finish()
         if journal is not None:

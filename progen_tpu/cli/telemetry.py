@@ -478,6 +478,105 @@ def summarize_cmd(events, metrics, top_spans, top_traces, slo_path):
     _echo_drops(drops.count)
 
 
+@main.command("query")
+@click.option(
+    "--trace", "trace_id", required=True,
+    help="the trace_id to reconstruct (router intake mints these)",
+)
+@click.option(
+    "--events", "events_paths", multiple=True,
+    type=click.Path(exists=True, dir_okay=False),
+    help="events.jsonl OR flight-*.json dump, repeatable (a killed "
+         "host's black box joins like a survivor's stream)",
+)
+@click.option(
+    "--journal", "journal_paths", multiple=True,
+    type=click.Path(exists=True, dir_okay=False),
+    help="serving journal.jsonl, repeatable (accept/token/done "
+         "records; the token stream is summarized first/last)",
+)
+@click.option(
+    "--tsdb", "tsdb_dir", type=click.Path(file_okay=False), default=None,
+    help="collector TSDB: samples whose exemplars name the trace",
+)
+@click.option(
+    "--notifications", "notify_paths", multiple=True,
+    type=click.Path(exists=True, dir_okay=False),
+    help="alerts.jsonl / notifications.jsonl, repeatable: any record "
+         "mentioning the trace joins the timeline",
+)
+@click.option(
+    "--logs", "log_dirs", multiple=True,
+    type=click.Path(exists=True, file_okay=False),
+    help="directory to auto-discover evidence under (recursive): "
+         "events.jsonl, journal.jsonl, flight-*.json, alerts.jsonl, "
+         "notifications.jsonl",
+)
+@click.option(
+    "--json", "json_out", type=click.Path(dir_okay=False), default=None,
+    help="also write the timeline as JSON",
+)
+def query_cmd(trace_id, events_paths, journal_paths, tsdb_dir,
+              notify_paths, log_dirs, json_out):
+    """Reconstruct one request's journey across every evidence stream.
+
+    Joins events.jsonl streams, flight-recorder dumps, serving
+    journals, collector TSDB exemplars and alert/notification ledgers
+    on a single trace_id and prints the merged chronological timeline —
+    a request that died with its replica still reads contiguously:
+    router intake -> dispatch -> the dead replica's journaled tokens
+    (from its flight dump) -> handoff -> the survivor's completion.
+    Exits 1 when the trace appears nowhere."""
+    from progen_tpu.telemetry import flight
+
+    events = [Path(p) for p in events_paths]
+    journals = [Path(p) for p in journal_paths]
+    notifies = [Path(p) for p in notify_paths]
+    for d in log_dirs:
+        root = Path(d)
+        events += sorted(root.rglob("events.jsonl"))
+        events += flight.find_dumps(root)
+        journals += sorted(root.rglob("journal*.jsonl"))
+        for name in ("alerts.jsonl", "notifications.jsonl"):
+            notifies += sorted(root.rglob(name))
+    # a file named both explicitly and via --logs must join only once
+    events = list(dict.fromkeys(p.resolve() for p in events))
+    journals = list(dict.fromkeys(p.resolve() for p in journals))
+    notifies = list(dict.fromkeys(p.resolve() for p in notifies))
+    drops = LineDrops()
+    timeline = flight.trace_timeline(
+        trace_id,
+        events=events,
+        journals=journals,
+        tsdb_dir=tsdb_dir,
+        extra_jsonl=notifies,
+        drops=drops,
+    )
+    if json_out is not None:
+        Path(json_out).parent.mkdir(parents=True, exist_ok=True)
+        Path(json_out).write_text(json.dumps(
+            {"trace_id": str(trace_id), "timeline": timeline},
+            indent=2, default=str,
+        ))
+    if not timeline:
+        click.echo(f"trace {trace_id}: no records found")
+        _echo_drops(drops.count)
+        sys.exit(1)
+    t0 = timeline[0]["ts"]
+    click.echo(
+        f"trace {trace_id}: {len(timeline)} records across "
+        f"{len({e['src'] for e in timeline})} streams, "
+        f"{timeline[-1]['ts'] - t0:.3f}s end to end"
+    )
+    for e in timeline:
+        stamp = time.strftime("%H:%M:%S", time.localtime(e["ts"]))
+        click.echo(
+            f"  {stamp} +{e['ts'] - t0:>8.3f}s "
+            f"{e['src']:<24} {e['what']}"
+        )
+    _echo_drops(drops.count)
+
+
 _DEFAULT_OBJECTIVES = (
     Path(__file__).resolve().parents[2] / "configs" / "serving"
     / "slo.toml"

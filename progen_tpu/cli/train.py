@@ -145,6 +145,17 @@ class AnomalyRollback(Exception):
 @click.option("--prom_port", default=0,
               help="serve the same train-loop exposition over HTTP on "
                    "this localhost port (0 = off)")
+@click.option("--flight_dir", default=None, type=str,
+              help="arm the flight recorder: bounded in-memory ring of "
+                   "recent telemetry, dumped atomically here on stall "
+                   "escalation, anomaly rollback, chaos kill, or an "
+                   "unhandled exception")
+@click.option("--profile_pin", "profile_pin_path", default=None, type=str,
+              help="profile.pin control file: a token written here "
+                   "starts a bounded jax.profiler window on the LIVE "
+                   "loop (acked through FILE.ack) — unlike "
+                   "--profile_dir's fixed steps 2-4, this profiles the "
+                   "moment something looks wrong")
 def main(
     seed,
     batch_size,
@@ -190,6 +201,8 @@ def main(
     anomaly_patience,
     prom_file,
     prom_port,
+    flight_dir,
+    profile_pin_path,
 ):
     from progen_tpu.checkpoint import Package, get_checkpoint_fns
     from progen_tpu.config import ProGenConfig, load_toml_config
@@ -426,6 +439,23 @@ def main(
 
     telemetry.configure(sink=tracker.log_event)
     ledger = GoodputLedger()
+
+    # forensics: the black box rides the telemetry tap; the profile pin
+    # is polled once per optimizer step alongside the watchdog beat
+    from progen_tpu.telemetry import flight as flight_mod
+
+    if flight_dir:
+        flight_mod.arm(flight_dir, metrics_fn=reg.snapshot)
+    prof_watcher = None
+    if profile_pin_path:
+        import os as _os
+
+        prof_watcher = flight_mod.ProfilePinWatcher(
+            profile_pin_path,
+            _os.path.join(
+                _os.path.dirname(profile_pin_path) or ".", "profiles"
+            ),
+        )
 
     # --- train-loop Prometheus: the registry already carries the
     # resilience counters and step_s reservoir; goodput + HBM ride in as
@@ -725,6 +755,8 @@ def main(
             pending = (global_step, metrics, step_bucket)
             if watchdog is not None:
                 watchdog.beat()
+            if prof_watcher is not None:
+                prof_watcher.poll_watch()
             if async_checkpoint:
                 # per-step poll of the background commit thread: a fatal
                 # commit error aborts at the NEXT step (with a
@@ -961,6 +993,9 @@ def main(
                 prom_srv.shutdown()
             if watchdog is not None:
                 watchdog.stop()
+            if prof_watcher is not None:
+                prof_watcher.close()  # flush an in-flight window
+            flight_mod.disarm()
             # detach the span sink BEFORE the tracker closes its files:
             # a later span in this process must not write to a dead fd
             telemetry.configure()

@@ -81,6 +81,18 @@ Fleet targets (progen_tpu/fleet/ — TCP transport and autoscaler):
                             the fleet (the router CLI skips the tick),
                             and ``kill@N`` dies inside the decision.
 
+Forensics targets (progen_tpu/telemetry/flight.py):
+
+  * ``flight/dump``     — span entry of a flight-recorder dump
+                          (``kill@N`` = die at the dump site: the
+                          atomic tmp+fsync+rename discipline must
+                          leave no file or a complete one, never a
+                          torn flight-*.json);
+  * ``profile/window``  — span entry of an on-demand profiler window
+                          (a fault here costs the window — it is
+                          rejected with a reason — never the serve
+                          loop).
+
 An unknown target (typo'd span name, renamed site) warns ONCE at
 install instead of silently never firing — a chaos rehearsal whose
 faults never land proves nothing.
@@ -104,6 +116,7 @@ KNOWN_TARGETS = frozenset({
     # spans
     "ckpt/finalize", "ckpt/restore", "ckpt/restore_params", "ckpt/save",
     "deploy/canary", "deploy/probe", "deploy/promote", "deploy/rollback",
+    "flight/dump", "profile/window",
     "router/handoff",
     "serve/prefill", "serve/prefill_chunk", "serve/reload",
     "serve/reload_commit",

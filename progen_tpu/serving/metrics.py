@@ -47,8 +47,10 @@ class ServingMetrics:
     def set_gauge(self, name: str, value: float) -> None:
         self.gauges[name] = float(value)
 
-    def observe(self, name: str, seconds: float) -> None:
-        self._timings.setdefault(name, _Timing()).observe(seconds)
+    def observe(self, name: str, seconds: float, trace_id=None) -> None:
+        self._timings.setdefault(name, _Timing()).observe(
+            seconds, trace_id=trace_id
+        )
 
     def declare_timing(self, name: str) -> None:
         """Pre-register a timing family at zero observations so the
@@ -114,6 +116,10 @@ class ServingMetrics:
                     "quantiles": {
                         str(q): t.quantile(q) for q, _ in _QUANTILES
                     },
+                    **(
+                        {"exemplars": t.exemplars()}
+                        if t._exemplars else {}
+                    ),
                 }
                 for name, t in self._timings.items()
             },

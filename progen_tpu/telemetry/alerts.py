@@ -153,10 +153,15 @@ class AlertSink:
             "reason": str(reason),
         })
 
-    def slo_transition(self, slo_rec: dict) -> dict:
+    def slo_transition(
+        self, slo_rec: dict, exemplars: Optional[dict] = None
+    ) -> dict:
         """Forward one ``ev:"slo"`` transition record (SloWatch output)
-        as an alert; the original burn numbers ride along."""
-        return self._emit({
+        as an alert; the original burn numbers ride along, and when the
+        caller has fleet trace exemplars (the collector does) the worst
+        trace ids land in the payload — the page names the requests
+        behind the burn, not just the quantile."""
+        rec = {
             "ev": "alert",
             "ts": float(slo_rec.get("ts", time.time())),
             "kind": "slo_burn",
@@ -166,4 +171,9 @@ class AlertSink:
             "burn_short": slo_rec.get("burn_short"),
             "burn_long": slo_rec.get("burn_long"),
             "value": slo_rec.get("value"),
-        })
+        }
+        if exemplars:
+            rec["exemplars"] = {
+                fam: list(exs) for fam, exs in exemplars.items() if exs
+            }
+        return self._emit(rec)

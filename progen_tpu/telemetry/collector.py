@@ -35,6 +35,7 @@ anywhere the exposition files are visible.
 
 from __future__ import annotations
 
+import math
 import re
 import time
 from dataclasses import dataclass
@@ -46,6 +47,7 @@ from progen_tpu.telemetry.slo import (
     SloConfig,
     SloWatch,
     evaluate,
+    parse_prom_exemplars,
     parse_prom_text,
 )
 
@@ -292,6 +294,8 @@ class Collector:
         alerts=None,
         window_s: Optional[float] = None,
         remote_write=None,
+        profile_pins: Sequence[str] = (),
+        profile_min_interval_s: float = 300.0,
     ):
         names = [s.name for s in sources]
         if len(set(names)) != len(names):
@@ -315,6 +319,11 @@ class Collector:
         self._watch = (
             SloWatch(slo_cfg, emit=self._emit_slo) if slo_cfg else None
         )
+        # on-demand forensics: pins to raise when an SLO starts burning
+        # (one per serve/train process we can ask to self-profile)
+        self.profile_pins = [str(p) for p in profile_pins]
+        self.profile_min_interval_s = float(profile_min_interval_s)
+        self._profile_last = -math.inf
         # restart continuity: seed the transition detectors from the
         # sink's persisted states so an edge that happened while this
         # collector was down still fires (and a condition it already
@@ -337,7 +346,12 @@ class Collector:
         except OSError:
             return None
         age = max(0.0, now - stat.st_mtime)
-        return age, parse_prom_text(text), prom_families(text)
+        return (
+            age,
+            parse_prom_text(text),
+            prom_families(text),
+            parse_prom_exemplars(text),
+        )
 
     def _scrape_source(self, src: SourceSpec, now: float) -> dict:
         counters: Dict[str, float] = {}
@@ -348,10 +362,16 @@ class Collector:
         if src.prom:
             got = self._scrape_prom(src.prom, now)
             if got is not None:
-                prom_age, vals, families = got
+                prom_age, vals, families, exemplars = got
                 counters, gauges, timings = split_prom_values(
                     vals, families
                 )
+                # trace exemplars ride the timing dicts (schema-free
+                # values) so they reach the TSDB / console / alerts
+                # without touching the sample record shape
+                for fam, exs in exemplars.items():
+                    if fam in timings and exs:
+                        timings[fam]["exemplars"] = exs
                 age = prom_age
                 seen = True
         tail = self._tails.get(src.name)
@@ -440,7 +460,39 @@ class Collector:
 
     def _emit_slo(self, rec: dict) -> None:
         if self.alerts is not None:
-            self.alerts.slo_transition(rec)
+            self.alerts.slo_transition(
+                rec, exemplars=fleet_exemplars(self._window)
+            )
+        # also forward through the telemetry stream: the SloWatch above
+        # is wired to this method *instead of* get_telemetry().emit, so
+        # without this the flight recorder's tap (which dumps on the
+        # burning edge) would never see collector-side transitions
+        from progen_tpu.telemetry.spans import get_telemetry
+
+        get_telemetry().emit(rec)
+        if rec.get("state") == "burning":
+            self._auto_profile(rec)
+
+    def _auto_profile(self, rec: dict) -> None:
+        """First burning edge → raise ``profile.pin`` on every
+        configured target so the processes behind the burn capture a
+        bounded trace window while the badness is still happening.
+        Rate-limited so a flapping objective cannot spam windows."""
+        if not self.profile_pins:
+            return
+        now = float(rec.get("ts", time.time()))
+        if now - self._profile_last < self.profile_min_interval_s:
+            return
+        self._profile_last = now
+        from progen_tpu.telemetry import flight
+
+        for pin in self.profile_pins:
+            try:
+                flight.request_profile(
+                    pin, token=f"slo-{rec.get('objective', 'burn')}-{int(now)}"
+                )
+            except OSError:
+                continue
 
 
 # -- fleet aggregation ----------------------------------------------------
@@ -650,6 +702,34 @@ def load_collector_config(path) -> Tuple[dict, List[SourceSpec]]:
             metrics=str(table["metrics"]) if table.get("metrics") else None,
         ))
     return settings, sources
+
+
+def fleet_exemplars(samples: Iterable[dict]) -> Dict[str, List[dict]]:
+    """Union per-source trace exemplars into the fleet's worst-K per
+    timing family. ``fleet_series`` flattens everything to floats, so
+    exemplars need this parallel rollup: the latest sample per source
+    contributes its exemplar list, and the fleet's worst-K is the
+    worst-K of the parts' worst-Ks (same invariant as
+    ``_Timing.merged`` — max is order-insensitive)."""
+    from progen_tpu.telemetry.registry import _Timing
+
+    pairs: Dict[str, List[Tuple[float, str]]] = {}
+    for rec in latest_by_source(samples).values():
+        for fam, tv in rec.get("timings", {}).items():
+            for ex in tv.get("exemplars") or []:
+                try:
+                    pairs.setdefault(fam, []).append(
+                        (float(ex["value"]), str(ex["trace_id"]))
+                    )
+                except (KeyError, TypeError, ValueError):
+                    continue
+    return {
+        fam: [
+            {"value": v, "trace_id": tid}
+            for v, tid in _Timing._worst_k(ps)
+        ]
+        for fam, ps in pairs.items()
+    }
 
 
 def latest_by_source(samples: Iterable[dict]) -> Dict[str, dict]:

@@ -31,7 +31,11 @@ import json
 import time
 from typing import Dict, List, Optional
 
-from progen_tpu.telemetry.collector import fleet_series, latest_by_source
+from progen_tpu.telemetry.collector import (
+    fleet_exemplars,
+    fleet_series,
+    latest_by_source,
+)
 from progen_tpu.telemetry.slo import evaluate, results_payload
 from progen_tpu.telemetry.trace import LineDrops, iter_jsonl
 
@@ -113,6 +117,10 @@ def build_snapshot(
         "as_of": as_of,
         "sources": sources,
         "fleet": fleet_now,
+        # worst-K trace exemplars per timing family, fleet-wide: the
+        # request ids behind the merged p99 (per-source exemplars ride
+        # each source's timings dict above)
+        "exemplars": fleet_exemplars(samples),
         "slo": slo,
         "slo_exit": gate,
         "alerts": alerts,
@@ -209,6 +217,16 @@ def render(snap: dict, color: bool = True,
         f"ttft p95 {_num(fleet.get('ttft_s_p95_s'), '{:.3f}')}s  "
         f"queue max {_num(fleet.get('queue_depth'))}"
     )
+    exemplars = snap.get("exemplars", {})
+    if exemplars:
+        lines.append(_c("slowest traces", _BOLD, color))
+        for fam in sorted(exemplars):
+            worst = exemplars[fam][:3]
+            tail = "  ".join(
+                f"{e.get('trace_id', '?')} ({_num(e.get('value'), '{:.3f}')}s)"
+                for e in worst
+            )
+            lines.append(f"  {fam:<12} {tail}")
     lines.extend(_render_alert_panes(snap, color))
     t = snap.get("tsdb", {})
     lines.append(_c(

@@ -42,6 +42,20 @@ def _name(prefix: str, raw: str) -> str:
     return n if not n[:1].isdigit() else f"_{n}"
 
 
+def escape_label_value(raw: str) -> str:
+    """OpenMetrics label-value escape (backslash, quote, newline) — the
+    exemplar ``trace_id`` is operator-influenced text riding inside a
+    quoted label, so it must round-trip exactly. The inverse lives in
+    ``telemetry.slo.unescape_label_value``; both sides of the
+    remote-write naming contract use this spelling."""
+    return (
+        str(raw)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 def _fmt(v: float) -> str:
     f = float(v)
     # Prometheus spellings for the non-finite values a gauge can carry
@@ -72,8 +86,27 @@ def prometheus_text(metrics, prefix: str = "progen_serve_") -> str:
         base = raw[: -len("_s")] if raw.endswith("_s") else raw
         n = _name(prefix, base + "_seconds")
         lines.append(f"# TYPE {n} summary")
-        for q, qv in sorted(t.get("quantiles", {}).items()):
-            lines.append(f'{n}{{quantile="{q}"}} {_fmt(qv)}')
+        # trace exemplars ride the quantile lines in OpenMetrics
+        # `# {trace_id="..."} value` syntax: the worst observation on
+        # the highest quantile, next-worst on the next, so a scrape of
+        # "p99 is slow" carries the request ids that made it slow
+        exemplars = list(t.get("exemplars") or [])
+        qitems = sorted(t.get("quantiles", {}).items())
+        ex_by_q = {
+            q: exemplars[i]
+            for i, (q, _) in enumerate(reversed(qitems))
+            if i < len(exemplars)
+        }
+        for q, qv in qitems:
+            line = f'{n}{{quantile="{q}"}} {_fmt(qv)}'
+            ex = ex_by_q.get(q)
+            if ex:
+                tid = escape_label_value(ex.get("trace_id", ""))
+                line += (
+                    f' # {{trace_id="{tid}"}} '
+                    f'{_fmt(ex.get("value", 0.0))}'
+                )
+            lines.append(line)
         lines.append(f"{n}_sum {_fmt(t['sum'])}")
         lines.append(f"{n}_count {_fmt(t['count'])}")
     return "\n".join(lines) + "\n"

@@ -30,6 +30,9 @@ from typing import Dict
 
 _RESERVOIR_CAP = 512
 _QUANTILES = ((0.5, "p50_s"), (0.95, "p95_s"), (0.99, "p99_s"))
+# worst-K trace exemplars retained per family: enough to name the
+# requests behind a burning p99, small enough to ride every exposition
+_EXEMPLAR_CAP = 4
 
 
 class _Timing:
@@ -37,9 +40,15 @@ class _Timing:
     (Vitter's Algorithm R) for tail quantiles — latency SLOs live at
     p99, where a mean is actively misleading. Seeded RNG keeps runs
     reproducible; memory is bounded at ``_RESERVOIR_CAP`` floats per
-    timing family regardless of observation count."""
+    timing family regardless of observation count.
 
-    __slots__ = ("sum", "count", "min", "max", "_reservoir", "_rng")
+    Observations that carry a ``trace_id`` additionally compete for the
+    worst-K exemplar slots (Dapper's aggregate→trace link): the K
+    largest values seen, each with the trace that produced it, so "p99
+    is slow" resolves to specific request ids."""
+
+    __slots__ = ("sum", "count", "min", "max", "_reservoir", "_rng",
+                 "_exemplars")
 
     def __init__(self):
         self.sum = 0.0
@@ -48,8 +57,20 @@ class _Timing:
         self.max = 0.0
         self._reservoir: list = []
         self._rng = random.Random(0)
+        self._exemplars: list = []  # [(value, trace_id)], worst first
 
-    def observe(self, v: float) -> None:
+    @staticmethod
+    def _worst_k(pairs) -> list:
+        """Top-``_EXEMPLAR_CAP`` (value, trace_id) pairs, one slot per
+        trace (a trace observed twice keeps its worst value)."""
+        best: Dict[str, float] = {}
+        for v, tid in pairs:
+            if tid not in best or v > best[tid]:
+                best[tid] = v
+        ranked = sorted(((v, t) for t, v in best.items()), reverse=True)
+        return ranked[:_EXEMPLAR_CAP]
+
+    def observe(self, v: float, trace_id=None) -> None:
         self.sum += v
         self.count += 1
         self.min = min(self.min, v)
@@ -60,6 +81,16 @@ class _Timing:
             j = self._rng.randrange(self.count)
             if j < _RESERVOIR_CAP:
                 self._reservoir[j] = v
+        if trace_id:
+            self._exemplars = self._worst_k(
+                self._exemplars + [(float(v), str(trace_id))]
+            )
+
+    def exemplars(self) -> list:
+        """Worst-K observations with their traces, worst first."""
+        return [
+            {"value": v, "trace_id": tid} for v, tid in self._exemplars
+        ]
 
     def quantile(self, q: float) -> float:
         if not self._reservoir:
@@ -99,6 +130,11 @@ class _Timing:
         out.sum = sum(p.sum for p in parts)
         out.min = min(p.min for p in parts)
         out.max = max(p.max for p in parts)
+        # exemplars union exactly: the fleet's worst-K is the worst-K
+        # of the parts' worst-Ks (max is order-insensitive)
+        out._exemplars = cls._worst_k(
+            pair for p in parts for pair in p._exemplars
+        )
         pool = []
         for p in parts:
             if not p._reservoir:
@@ -143,9 +179,11 @@ class MetricsRegistry:
             for k, v in mapping.items():
                 self.gauges[k] = float(v)
 
-    def observe(self, name: str, seconds: float) -> None:
+    def observe(self, name: str, seconds: float, trace_id=None) -> None:
         with self._lock:
-            self._timings.setdefault(name, _Timing()).observe(seconds)
+            self._timings.setdefault(name, _Timing()).observe(
+                seconds, trace_id=trace_id
+            )
 
     def snapshot(self) -> Dict[str, float]:
         """Flat dict of everything — tracker-loggable."""
@@ -175,6 +213,10 @@ class MetricsRegistry:
                         "quantiles": {
                             str(q): t.quantile(q) for q, _ in _QUANTILES
                         },
+                        **(
+                            {"exemplars": t.exemplars()}
+                            if t._exemplars else {}
+                        ),
                     }
                     for name, t in self._timings.items()
                 },

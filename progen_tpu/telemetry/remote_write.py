@@ -20,6 +20,18 @@ rendered payload round-trips to the collector's own normalized keys:
     plus ``progen_<fam>_seconds_sum`` / ``_count`` (the derived
     ``<fam>_mean_s`` gauge is omitted — it is ``sum/count`` in PromQL)
 
+One deliberate omission from this bridge: the worst-K trace exemplars
+that ride the scrape-side exposition as OpenMetrics
+``# {trace_id="..."} value`` annotations (see
+``telemetry.prometheus.escape_label_value`` for the backslash/quote/
+newline escaping both sides of that contract must share — the
+trace_id is operator-influenced text inside a quoted label, so a raw
+``"`` or ``\\n`` would tear the exposition line). Remote-write v1 has
+no exemplar field; the fleet's exemplars stay queryable locally via
+the TSDB samples and ``progen-tpu-telemetry query --trace``, and the
+escape/unescape pair (``telemetry.slo.unescape_label_value``) is what
+keeps them intact from replica exposition through collector merge.
+
 Delivery discipline (the part that keeps the scrape loop honest):
 
   * ``offer()`` never blocks and never raises — points land in a

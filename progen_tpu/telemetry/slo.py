@@ -62,10 +62,35 @@ _PROM_PREFIXES = ("progen_router_", "progen_serve_", "progen_")
 # so one objective key addresses both evidence sources
 _QUANTILE_KEYS = {"0.5": "p50_s", "0.95": "p95_s", "0.99": "p99_s"}
 
+# the optional tail is an OpenMetrics exemplar (`# {trace_id="..."} v`)
+# — tolerated on any sample line so exemplar-bearing expositions parse
+# to the same values as plain ones (the exemplars themselves are read
+# by parse_prom_exemplars)
 _SAMPLE_RE = re.compile(
-    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)\s*$"
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)"
+    r"(?:\s+#\s*\{.*\}\s+\S+)?\s*$"
 )
 _QUANT_RE = re.compile(r'quantile="([^"]+)"')
+_EXEMPLAR_RE = re.compile(
+    r'#\s*\{trace_id="((?:[^"\\]|\\.)*)"\}\s+(\S+)\s*$'
+)
+
+
+def unescape_label_value(raw: str) -> str:
+    """Inverse of ``telemetry.prometheus.escape_label_value`` — the
+    scrape side of the exemplar trace_id round-trip."""
+    out = []
+    i = 0
+    while i < len(raw):
+        c = raw[i]
+        if c == "\\" and i + 1 < len(raw):
+            nxt = raw[i + 1]
+            out.append("\n" if nxt == "n" else nxt)
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
 
 
 def parse_prom_text(text: str) -> Dict[str, float]:
@@ -112,6 +137,40 @@ def parse_prom_text(text: str) -> Dict[str, float]:
         else:
             out[name] = value
     return out
+
+
+def parse_prom_exemplars(text: str) -> Dict[str, list]:
+    """The exemplar side-channel of an exposition: normalized
+    timing-family key (``ttft_s``) → worst-first
+    ``[{"value", "trace_id"}]`` parsed from the OpenMetrics
+    ``# {trace_id="..."} value`` suffixes the renderer attaches to
+    summary quantile lines. Families without exemplars are absent."""
+    fams: Dict[str, list] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        em = _EXEMPLAR_RE.search(line)
+        if em is None or _SAMPLE_RE.match(line) is None:
+            continue
+        name = _SAMPLE_RE.match(line).group(1)
+        try:
+            value = float(em.group(2))
+        except ValueError:
+            continue
+        for p in _PROM_PREFIXES:
+            if name.startswith(p):
+                name = name[len(p):]
+                break
+        if name.endswith("_seconds"):
+            name = name[: -len("_seconds")] + "_s"
+        fams.setdefault(name, []).append({
+            "value": value,
+            "trace_id": unescape_label_value(em.group(1)),
+        })
+    for exs in fams.values():
+        exs.sort(key=lambda e: -e["value"])
+    return fams
 
 
 def read_prom_file(path, now: Optional[float] = None):

@@ -94,6 +94,14 @@ class EventLog:
 # injector (resilience/chaos.py) registers here; empty list = no-op.
 SPAN_ENTRY_HOOKS: list = []
 
+# emit taps: called with every record that reaches Telemetry.emit —
+# BEFORE the sink check, so a tap sees records even on a sink-less
+# process (spans still maintain the ring with no events.jsonl). The
+# flight recorder (telemetry/flight.py) registers here; a tap must
+# never raise and never block (it runs on the training/serving hot
+# path). Empty list = no-op.
+EMIT_TAPS: list = []
+
 
 def _named_scope(name: str):
     try:
@@ -123,15 +131,17 @@ class Telemetry:
         self._sink = sink
 
     def emit(self, record: dict) -> None:
-        sink = self._sink
-        if sink is None:
-            return
         # every record carries its host: under multi-process training the
         # per-host event files merge into one trace, and pid is what the
         # trace/skew tooling groups on (MegaScale-style straggler
         # attribution needs the host on *every* retry/anomaly/stall line,
         # not just spans)
         record.setdefault("pid", host_index())
+        for tap in EMIT_TAPS:
+            tap(record)
+        sink = self._sink
+        if sink is None:
+            return
         try:
             sink(record)
         except (OSError, ValueError):

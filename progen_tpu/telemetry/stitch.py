@@ -52,7 +52,12 @@ import time
 from pathlib import Path
 from typing import Dict, Iterable, List, Sequence, Tuple
 
-from progen_tpu.telemetry.trace import LineDrops, build_trace, iter_jsonl
+from progen_tpu.telemetry.trace import (
+    LineDrops,
+    build_trace,
+    iter_events_any,
+    iter_jsonl,
+)
 
 # beacon anchor slices get a small fixed width so the step_sync flows
 # have a slice to bind to and stay clickable at fleet zoom
@@ -366,7 +371,10 @@ def stitch_trace(
     its argument position as its pid (serving fleets share a host, so
     every process stamps pid 0 — indistinguishable tracks otherwise)."""
     drops = LineDrops()
-    streams = [list(iter_jsonl(p, drops)) for p in event_paths]
+    # iter_events_any: a stream argument may be a flight-recorder dump
+    # (flight-*.json) instead of events.jsonl — a SIGKILLed host's black
+    # box stitches in as its own track next to the survivors
+    streams = [list(iter_events_any(p, drops)) for p in event_paths]
     if force_hosts:
         hosts = list(range(len(streams)))
     else:

@@ -58,7 +58,7 @@ INSTANT_EVENTS = (
     "retry", "anomaly", "anomaly_rollback", "stall", "stall_escalation",
     "ckpt_quarantine", "ckpt_commit_failed", "chaos", "goodput",
     "clock_beacon", "request_rejected", "reload", "journal_replay",
-    "route", "slo", "alert",
+    "route", "slo", "alert", "flight", "profile",
 )
 
 # metrics.jsonl columns that get their own counter track
@@ -100,6 +100,37 @@ def iter_jsonl(path, drops: Optional[LineDrops] = None) -> Iterator[dict]:
                 yield rec
             elif drops is not None:
                 drops.count += 1
+
+
+def iter_events_any(
+    path, drops: Optional[LineDrops] = None
+) -> Iterator[dict]:
+    """Telemetry records from events.jsonl OR a flight-recorder dump.
+
+    A crashed host leaves no events.jsonl tail past its last flush —
+    its black box (``flight-<host>-<ts>.json``, telemetry/flight.py)
+    holds the final seconds instead. Dumps replay their captured ring
+    through the same iterator shape, so export-trace and stitch render
+    a dead host's last moments exactly like a survivor's stream. A dump
+    that fails digest verification counts as one dropped line rather
+    than raising: a torn dump from a badly-timed kill must not take the
+    rest of a fleet trace down with it."""
+    from progen_tpu.telemetry import flight
+
+    if flight.is_dump_path(path):
+        try:
+            records = flight.dump_records(path)
+        except (OSError, ValueError):
+            if drops is not None:
+                drops.count += 1
+            return
+        for rec in records:
+            if isinstance(rec, dict):
+                yield rec
+            elif drops is not None:
+                drops.count += 1
+        return
+    yield from iter_jsonl(path, drops)
 
 
 def _us(ts: float) -> float:
@@ -295,7 +326,7 @@ def export_trace(
     metrics: list = []
     if metrics_path is not None and Path(metrics_path).exists():
         metrics = list(iter_jsonl(metrics_path, drops))
-    trace = build_trace(iter_jsonl(events_path, drops), metrics)
+    trace = build_trace(iter_events_any(events_path, drops), metrics)
     trace["progenDroppedLines"] = drops.count
     out_path = Path(out_path)
     out_path.parent.mkdir(parents=True, exist_ok=True)

@@ -58,18 +58,21 @@ def load_trajectory(repo_root) -> list:
 
 # Serving chains: dimensionless "win" ratios from the serving phases —
 # decode-admit-stall's monolithic/chunked ITL p99 and cold/hit TTFT,
-# plus transport-overhead's framed-TCP vs unix-socket parity (min of
-# the tokens/s and TTFT ratios; ~1.0 when the frame envelope is free).
-# Higher is better, exactly like the throughput chains, so the same
-# ratchet applies. Each observation is either the round's own headline
-# (``metric`` matches) or a same-named direct key any round may attach
-# (the carry idiom ``last_tpu_record`` established: a round whose
-# headline is the train number still keeps the serving record on the
-# chain).
+# transport-overhead's framed-TCP vs unix-socket parity (min of the
+# tokens/s and TTFT ratios; ~1.0 when the frame envelope is free), and
+# flight-overhead's armed vs disarmed flight-recorder parity (min of
+# the tokens/s and ITL-p99 ratios; the always-on black box must stay
+# within ~1% of free). Higher is better, exactly like the throughput
+# chains, so the same ratchet applies. Each observation is either the
+# round's own headline (``metric`` matches) or a same-named direct key
+# any round may attach (the carry idiom ``last_tpu_record``
+# established: a round whose headline is the train number still keeps
+# the serving record on the chain).
 SERVE_CHAINS = (
     "serve_admit_stall_ratio",
     "serve_prefix_cache_speedup",
     "serve_transport_parity",
+    "flight_overhead_ratio",
 )
 
 

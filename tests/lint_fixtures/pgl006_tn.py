@@ -147,3 +147,22 @@ def clean_deploy_metrics(reg):
 def clean_other_ev_dict():
     # dict literals with other ev tags are not the collector's grammar
     return {"ev": "tsdb_block", "seq": 4, "level": 1}
+
+
+def clean_flight_consumer(records):
+    # consuming flight-dump receipts (query --trace, the forensics
+    # smoke) is fine — only EMITTING the raw record is restricted to
+    # telemetry/flight.py
+    return [r for r in records if r.get("op") == "dumped"]
+
+
+def clean_flight_metrics(reg):
+    # forensics METRICS are fine anywhere — only raw ev:"flight"
+    # records are restricted to telemetry/flight.py
+    reg.inc("flight_dumps")
+
+
+def clean_profile_consumer(records):
+    # pairing requested windows with their started/stopped acks is a
+    # consumer concern — only emitting the raw record is restricted
+    return [r for r in records if r.get("op") in ("started", "stopped")]

@@ -1,4 +1,4 @@
-"""PGL006 true positives: telemetry hygiene. Expected findings: 47."""
+"""PGL006 true positives: telemetry hygiene. Expected findings: 51."""
 
 
 def unbounded_span(telemetry, name):
@@ -178,3 +178,17 @@ def bad_deploy_op():
     # observed/canary/probe/promote/rollback/converged alphabet
     return {"ev": "deploy", "ts": 1.0, "op": "shipped",
             "ckpt": "ckpt_000001"}
+
+
+def bad_flight_op(emit):
+    # TP x2: flight record outside telemetry/flight.py AND an op
+    # outside the armed/dumped/truncated black-box alphabet
+    emit({"ev": "flight", "ts": 1.0, "op": "crashed",
+          "path": "/tmp/flight-host-1.json"})
+
+
+def bad_profile_op(emit):
+    # TP x2: profile record outside telemetry/flight.py AND an op
+    # outside the requested/started/stopped/rejected window alphabet
+    emit({"ev": "profile", "ts": 1.0, "op": "running",
+          "token": "slo-ttft-1"})

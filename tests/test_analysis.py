@@ -29,7 +29,7 @@ EXPECTED_TP = {
     "PGL003": 2,
     "PGL004": 4,
     "PGL005": 2,
-    "PGL006": 47,
+    "PGL006": 51,
 }
 
 
