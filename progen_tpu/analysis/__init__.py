@@ -17,16 +17,40 @@ Rules (see each module's docstring for the full rationale):
   PGL004  recompilation hazards (varying/unhashable static args,
           jit-of-fresh-lambda, branch on traced values)
   PGL005  side effects inside traced code (run once, at trace time)
-  PGL006  telemetry hygiene (literal span names, B/E via the context
-          manager, Prometheus-legal metric names)
+  PGL006  telemetry hygiene (literal span names, event-grammar
+          producer checks via analysis/event_grammar.py,
+          Prometheus-legal metric names)
+  PGL007  durable-path write discipline (atomic tmp+fsync+replace
+          publishes, fsync'd ledger appends)
+  PGL008  lock discipline (guarded-attr consistency; no blocking
+          locks or lock-holding I/O in tap/excepthook/signal handlers)
+  PGL009  chaos-site drift (every PROGEN_CHAOS target referenced in
+          tests/CI/docs names an installed site; KNOWN_TARGETS
+          matches the installed surface) — whole-project pass
+  PGL010  event-grammar exhaustiveness, consumer side (dispatches on
+          op/status/state handle every declared value or carry a
+          default branch)
 
 Suppress a single accepted finding inline with
 ``# progen: ignore[PGL005]``; grandfathered findings live in
 ``lint_baseline.json`` with a reason string each (analysis/runner.py).
 """
 
-from progen_tpu.analysis.core import Finding, ModuleContext, Rule
+from progen_tpu.analysis.core import (
+    Finding,
+    ModuleContext,
+    ProjectRule,
+    Rule,
+)
+from progen_tpu.analysis.event_grammar import (
+    BY_EV,
+    GRAMMARS,
+    EventGrammar,
+    render_grammar_markdown,
+)
+from progen_tpu.analysis.project import ProjectContext, default_text_files
 from progen_tpu.analysis.runner import (
+    PROJECT_RULES,
     RULE_DOCS,
     RULES,
     BaselineError,
@@ -41,14 +65,22 @@ from progen_tpu.analysis.traced import TracedIndex
 __all__ = [
     "Finding",
     "ModuleContext",
+    "ProjectContext",
+    "ProjectRule",
     "Rule",
     "RULES",
+    "PROJECT_RULES",
     "RULE_DOCS",
+    "BY_EV",
+    "GRAMMARS",
+    "EventGrammar",
     "BaselineError",
     "TracedIndex",
+    "default_text_files",
     "discover_files",
     "lint_file",
     "lint_paths",
     "load_baseline",
+    "render_grammar_markdown",
     "report_json",
 ]

@@ -224,6 +224,60 @@ class ModuleContext:
         return self.traced_index.in_traced_region(node)
 
 
+class ProjectRule:
+    """Base class for whole-project rules: one instance lints one
+    :class:`~progen_tpu.analysis.project.ProjectContext` (cross-module
+    indices built once by the runner, shared by every project rule).
+
+    Module-scoped findings go through :meth:`report_at`, which honors
+    inline ``# progen: ignore[...]`` suppressions exactly like
+    :class:`Rule.report`; findings anchored in non-Python files (a CI
+    workflow, a README) go through :meth:`report_text` — no inline
+    suppression there, the baseline is the only grandfathering
+    mechanism.
+    """
+
+    id = "PGL000"
+    severity = "error"
+    doc = ""
+
+    def __init__(self, project):
+        self.project = project
+        self.findings: List[Finding] = []
+
+    def run(self) -> List[Finding]:
+        raise NotImplementedError
+
+    def report_at(self, ctx: "ModuleContext", node: ast.AST,
+                  message: str) -> None:
+        line = getattr(node, "lineno", 0)
+        if ctx.is_suppressed(self.id, line):
+            return
+        self.findings.append(
+            Finding(
+                rule=self.id,
+                severity=self.severity,
+                path=ctx.path,
+                line=line,
+                col=getattr(node, "col_offset", 0),
+                message=message,
+                func=ctx.qualname(node),
+            )
+        )
+
+    def report_text(self, path: str, line: int, message: str) -> None:
+        self.findings.append(
+            Finding(
+                rule=self.id,
+                severity=self.severity,
+                path=str(path),
+                line=int(line),
+                col=0,
+                message=message,
+            )
+        )
+
+
 @dataclass
 class Rule(ast.NodeVisitor):
     """Base class: one rule instance lints one module. Subclasses set

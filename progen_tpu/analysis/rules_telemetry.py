@@ -1,4 +1,4 @@
-"""PGL006 — telemetry hygiene.
+"""PGL006 — telemetry hygiene, driven by the event-grammar registry.
 
 Span hygiene only pays off when it is enforced (Dapper's lesson): a
 span name that varies per call explodes the name cardinality the
@@ -7,115 +7,30 @@ that never gets its ``E`` (an exception, an early return) corrupts the
 open-span accounting the stall watchdog reports from. And a metric name
 that fails the Prometheus grammar gets silently mangled by
 ``telemetry/prometheus.py``'s ``_name()`` at render time — the
-dashboard query then matches nothing. Three checks:
+dashboard query then matches nothing.
 
-  * ``span(...)`` / ``.span(...)`` names must be string literals
-    (a bare name is allowed only when the enclosing function forwards
-    its own parameter — the wrapper pattern ``spans.span`` itself uses);
-  * raw ``"ev": "B"``/``"ev": "E"`` records must not be emitted outside
-    ``telemetry/spans.py`` — B/E pairing goes through the ``span()``
-    context manager, whose ``finally`` guarantees the E;
+The per-``ev`` record grammars (which module may build each record
+family, which fields are required, which values each enum field
+allows) live in one declarative table: ``analysis/event_grammar.py``.
+This rule is the PRODUCER side of that registry — it checks every
+record-building site against the declaration. PGL010
+(rules_grammar_consumers.py) is the consumer side: readers dispatching
+on the same enum fields must handle every declared value. Extending a
+grammar (a new op, a new record family) means editing the registry
+once; both rules and the generated README reference section follow.
+
+Beyond the registry, three bespoke checks survive here because they
+are not per-``ev`` grammars:
+
+  * ``span(...)`` / ``.span(...)`` names must be string literals (a
+    bare name is allowed only when the enclosing function forwards its
+    own parameter — the wrapper pattern ``spans.span`` itself uses);
   * string-literal metric names fed to the registry (``.inc``,
-    ``.set_gauge``, ``.observe``, ``.set_gauges`` keys) and literal
-    ``"ev"`` values must already satisfy the Prometheus name rules the
-    renderer enforces (``[a-zA-Z_:][a-zA-Z0-9_:]*``) — this covers the
-    PR-7 names (``clock_beacon``, ``itl_s``, ``slots`` /
-    ``slot_occupancy``) like any other;
-  * raw ``"ev": "req"`` async-lifecycle records must not be emitted
-    outside ``serving/scheduler.py`` or ``serving/router.py`` — those
-    two own the queued/prefill/decode (and routed/dispatched) phase
-    grammar and the every-``b``-gets-its-``e`` exception-safety burden
-    (same reasoning as B/E ↔ spans.py), and a literal ``"ph"`` in a
-    req record must be one of ``"b"``/``"n"``/``"e"`` (the async
-    trace-event alphabet);
-  * raw ``"ev": "route"`` records must not be emitted outside
-    ``serving/router.py``, and a literal ``"status"`` must be one of
-    ``dispatched``/``handoff``/``shed``/``replica_down`` — the router
-    section of ``summarize`` (and the failover smoke in CI) keys on
-    exactly this alphabet;
-  * raw ``"ev": "journal"`` records must not be emitted outside
-    ``serving/journal.py`` — the replay journal's ``op`` grammar
-    (``accept``/``token``/``done``) IS the crash-recovery contract
-    (a free-hand record replay can't parse is silently lost work), and
-    a literal ``"op"`` must come from that alphabet;
-  * raw ``"ev": "reload"`` records must not be emitted outside
-    ``serving/reload.py``, and a literal ``"status"`` must be one of
-    ``staged``/``committed``/``rejected`` — the zero-downtime smoke in
-    CI greps these to assert a reload fully applied or fully didn't.
-  * raw ``"ev": "score"`` records must not be emitted outside
-    ``progen_tpu/workloads/``, and a literal ``"op"`` must be one of
-    ``start``/``resume``/``batch``/``skip``/``done`` — the batch-score
-    journal's grammar is the resume/progress contract the CI workloads
-    smoke (and ``summarize``) read.
-  * raw ``"ev": "prefix_cache"`` records must not be emitted outside
-    ``serving/prefix_cache.py``, and a literal ``"op"`` must be one of
-    ``hit``/``miss``/``evict`` — cache-reuse accounting (and the CI
-    serving smoke's hit assertion) key on exactly this alphabet.
-  * raw ``"ev": "slo"`` records must not be emitted outside
-    ``telemetry/slo.py`` — the watchtower's transition grammar is what
-    the SLO gate and summarize key on — and a literal ``"state"`` must
-    be one of ``ok``/``warn``/``burning``/``resolved``.
-  * the trace-context field on ``req``/``route`` records is spelled
-    exactly ``trace_id`` — the stitcher's journey grouping and the
-    kill-matrix contiguity assert grep that one key; a literal
-    ``"trace"``/``"traceid"``-style key is a silently-dropped hop.
-  * ``"ev": "sample"`` dict literals (the fleet collector's scrape
-    records) may only be built in ``telemetry/collector.py`` — every
-    sample goes through ``make_sample`` so the TSDB, the fleet
-    aggregator, and the console all agree on one schema; a literal
-    ``"role"`` must be ``replica``/``router``/``run``. Checked on ALL
-    dict literals (not just ``emit(...)`` args): samples are written
-    through the TSDB, not the telemetry sink.
-  * ``"ev": "alert"`` dict literals may only be built in
-    ``telemetry/alerts.py`` (the ``AlertSink`` constructors), must
-    carry the ``kind``/``state``/``source``/``objective`` fields the
-    alert relay and the CI fleet-metrics smoke key on, and literal
-    ``kind``/``state`` values must come from the
-    ``staleness``/``slo_burn``/``deploy_rollback`` and
-    ``stale``/``fresh``/``warn``/``burning``/``resolved``/
-    ``rolled_back`` alphabets.
-  * ``"ev": "scale"`` dict literals (autoscaler decisions) may only be
-    built in ``fleet/autoscaler.py``, must carry ``action`` and
-    ``reason`` (the CI autoscale smoke asserts an up AND a down were
-    observed, by exactly those fields), and a literal ``action`` must
-    be ``up``/``down``/``hold``.
-  * ``"ev": "frame_drop"`` dict literals (rejected transport frames)
-    may only be built in ``fleet/transport.py`` — a drop record is the
-    transport's proof a frame was condemned, and a hand-rolled one
-    would claim enforcement that never ran; a literal ``reason`` must
-    come from the ``bad_magic``/``bad_version``/``bad_auth``/
-    ``oversized``/``chaos``/``idle_timeout`` alphabet.
-  * ``"ev": "notify"`` dict literals (alert delivery decisions) may
-    only be built in ``telemetry/alert_router.py`` — a notify record
-    claims the dedup/silence/rate pipeline ran; a hand-rolled one
-    forges a delivery the on-call never received. A literal ``status``
-    must come from the ``sent``/``failed``/``silenced``/``deduped``/
-    ``escalated`` delivery alphabet (the console counts and the CI
-    egress smoke key on exactly these).
-  * ``"ev": "ship"`` dict literals (TSDB retention-tier decisions) may
-    only be built in ``telemetry/tsdb.py`` — a ship record is the
-    shipper's proof a block's digest was verified into the archive
-    manifest; a literal ``op`` must come from the ``shipped``/
-    ``skipped``/``verify_failed`` alphabet.
-  * raw ``"ev": "flight"`` records must not be emitted outside
-    ``telemetry/flight.py`` — a ``dumped`` record is the flight
-    recorder's receipt that a sealed, digest-valid black box reached
-    disk (the forensics smoke and ``query --trace`` key on it); a
-    literal ``op`` must come from the ``armed``/``dumped``/
-    ``truncated`` alphabet.
-  * raw ``"ev": "profile"`` records must not be emitted outside
-    ``telemetry/flight.py`` — the profile pin ledger pairs
-    ``requested`` with ``started``/``stopped`` (or ``rejected``) so
-    an on-demand ``jax.profiler`` window is provably bounded and
-    rate-limited; a literal ``op`` must come from that alphabet.
-  * ``"ev": "deploy"`` dict literals (deployment decisions) may only
-    be built in ``progen_tpu/deploy/`` — the deploy ledger is the
-    controller's resume authority, and a hand-rolled record forges a
-    canary/promote/rollback decision the controller never made; a
-    literal ``op`` must come from the ``observed``/``canary``/
-    ``probe``/``promote``/``rollback``/``converged`` alphabet (the CI
-    deployment smoke and the kill-matrix convergence asserts key on
-    exactly these).
+    ``.set_gauge``, ``.observe``, ``.set_gauges`` keys) must satisfy
+    the Prometheus name rules the renderer enforces
+    (``[a-zA-Z_:][a-zA-Z0-9_:]*``);
+  * an ``ev`` tag with no registered grammar must still be a clean
+    greppable identifier, and must be a string literal when emitted.
 """
 
 from __future__ import annotations
@@ -124,9 +39,17 @@ import ast
 import re
 
 from progen_tpu.analysis.core import Rule, call_name
+from progen_tpu.analysis.event_grammar import (
+    BY_EV,
+    GRAMMARS,
+    TRACE_KEY_MISSPELLINGS,
+    EventGrammar,
+)
 
 _PROM_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _REGISTRY_METHODS = ("inc", "set_gauge", "observe")
+
+_DICT_SCOPE_GRAMMARS = tuple(g for g in GRAMMARS if g.scope == "dict")
 
 
 def _str_const(node) -> bool:
@@ -136,22 +59,10 @@ def _str_const(node) -> bool:
 class TelemetryHygieneRule(Rule):
     id = "PGL006"
     severity = "error"
-    doc = ("span/metric naming hygiene: literal span names, B/E only "
-           "via the span() context manager, Prometheus-legal metric "
-           "names")
-
-    def _in_spans_module(self) -> bool:
-        return self.ctx.path.replace("\\", "/").endswith(
-            "telemetry/spans.py"
-        )
-
-    def _in_scheduler_module(self) -> bool:
-        return self.ctx.path.replace("\\", "/").endswith(
-            "serving/scheduler.py"
-        )
-
-    def _in_module(self, tail: str) -> bool:
-        return self.ctx.path.replace("\\", "/").endswith(tail)
+    doc = ("event-grammar producer hygiene: literal span names, every "
+           "ev record family built only by its registered owner with "
+           "declared required fields and enum alphabets "
+           "(analysis/event_grammar.py), Prometheus-legal metric names")
 
     def _enclosing_params(self, node) -> set:
         fn = self.ctx.enclosing_function(node)
@@ -179,180 +90,83 @@ class TelemetryHygieneRule(Rule):
                     if _str_const(k):
                         self._check_prom_name(k, k.value)
 
-    # collector-record grammar: checked on every dict literal, because
-    # samples/alerts reach disk through the TSDB / AlertSink file, not
-    # through emit() — an emit-only check would never see them
-    _ALERT_FIELDS = ("kind", "state", "source", "objective")
-    _ALERT_KINDS = ("staleness", "slo_burn", "deploy_rollback")
-    _ALERT_STATES = ("stale", "fresh", "warn", "burning", "resolved",
-                     "rolled_back")
-    _SAMPLE_ROLES = ("replica", "router", "run")
-    _SCALE_FIELDS = ("action", "reason")
-    _SCALE_ACTIONS = ("up", "down", "hold")
-    _DROP_REASONS = ("bad_magic", "bad_version", "bad_auth",
-                     "oversized", "chaos", "idle_timeout")
-    _NOTIFY_STATUSES = ("sent", "failed", "silenced", "deduped",
-                        "escalated")
-    _SHIP_OPS = ("shipped", "skipped", "verify_failed")
-    _DEPLOY_OPS = ("observed", "canary", "probe", "promote",
-                   "rollback", "converged")
-
     def visit_Dict(self, node: ast.Dict) -> None:
+        # dict-scope grammars run on EVERY dict literal: samples/alerts/
+        # scale/... records reach disk through the TSDB or an alert
+        # file, not through emit() — an emit-only check would never see
+        # them
         self.generic_visit(node)
         for k, v in zip(node.keys, node.values):
             if not (_str_const(k) and k.value == "ev" and _str_const(v)):
                 continue
-            if v.value == "sample":
-                if not self._in_module("telemetry/collector.py"):
-                    self.report(
-                        v,
-                        "raw collector sample record built outside "
-                        "telemetry/collector.py — the TSDB, the fleet "
-                        "aggregator and the ops console all parse one "
-                        "schema; build samples with make_sample()",
-                    )
-                self._check_literal_member(
-                    node, "role", self._SAMPLE_ROLES,
-                    "sample record 'role'",
-                    "fleet aggregation buckets liveness by exactly "
-                    "these roles",
+            grammar = BY_EV.get(v.value)
+            if grammar is not None and grammar.scope == "dict":
+                self._check_grammar(node, v, grammar)
+
+    # ----- registry-driven record checks ----------------------------------
+
+    def _check_grammar(self, d: ast.Dict, ev_node,
+                       grammar: EventGrammar) -> None:
+        if not grammar.owns(self.ctx.path):
+            self.report(ev_node, grammar.owner_message)
+        if grammar.required:
+            present = {kk.value for kk in d.keys if _str_const(kk)}
+            missing = [f for f in grammar.required if f not in present]
+            if missing:
+                self.report(
+                    ev_node,
+                    f"{grammar.ev} record missing field(s) "
+                    f"{'/'.join(missing)} — {grammar.required_message}",
                 )
-            elif v.value == "alert":
-                if not self._in_module("telemetry/alerts.py"):
+        for enum in grammar.enums:
+            for k, v in zip(d.keys, d.values):
+                if not (_str_const(k) and k.value == enum.field):
+                    continue
+                if _str_const(v) and v.value not in enum.values:
                     self.report(
                         v,
-                        "raw alert record built outside "
-                        "telemetry/alerts.py — alerts are edge-triggered "
-                        "state machines; a hand-rolled record bypasses "
-                        "the transition dedup and the field grammar the "
-                        "relay/CI smoke key on; go through AlertSink",
+                        f"{enum.what} is '{v.value}' — must be one of "
+                        f"{'/'.join(enum.values)}: {enum.why}",
                     )
-                present = {
-                    kk.value for kk in node.keys if _str_const(kk)
-                }
-                missing = [
-                    f for f in self._ALERT_FIELDS if f not in present
-                ]
-                if missing:
+        if grammar.check_trace_key:
+            for k in d.keys:
+                if _str_const(k) and k.value in TRACE_KEY_MISSPELLINGS:
                     self.report(
-                        v,
-                        f"alert record missing field(s) "
-                        f"{'/'.join(missing)} — the alert relay and the "
-                        f"fleet-metrics smoke key on "
-                        f"kind/state/source/objective being present on "
-                        f"every alert",
+                        k,
+                        f"trace-context key '{k.value}' — the blessed "
+                        f"spelling is 'trace_id' (stitch journey "
+                        f"grouping and the kill-matrix contiguity "
+                        f"assert grep exactly that key); a misspelled "
+                        f"hop silently falls out of its journey",
                     )
-                self._check_literal_member(
-                    node, "kind", self._ALERT_KINDS,
-                    "alert record 'kind'",
-                    "only staleness, slo_burn and deploy_rollback "
-                    "alerts exist; a new kind needs the grammar (and "
-                    "this rule) extended",
+
+    def _check_event_dict(self, d: ast.Dict) -> None:
+        for k, v in zip(d.keys, d.values):
+            if not (_str_const(k) and k.value == "ev"):
+                continue
+            if not _str_const(v):
+                self.report(
+                    v,
+                    "event 'ev' tag must be a string literal so event "
+                    "streams stay greppable",
                 )
-                self._check_literal_member(
-                    node, "state", self._ALERT_STATES,
-                    "alert record 'state'",
-                    "the console colors and the smoke's quiet/burn "
-                    "asserts only know these states",
-                )
-            elif v.value == "scale":
-                if not self._in_module("fleet/autoscaler.py"):
+                continue
+            grammar = BY_EV.get(v.value)
+            if grammar is None:
+                if not _PROM_NAME_RE.match(v.value):
                     self.report(
                         v,
-                        "raw scale record built outside "
-                        "fleet/autoscaler.py — scaling decisions are the "
-                        "policy engine's judgment (hysteresis, cooldowns, "
-                        "edge-triggering), and the CI autoscale smoke "
-                        "keys on its records alone; go through "
-                        "Autoscaler.decide, not hand-rolled records",
+                        f"event tag '{v.value}' is not a clean "
+                        f"identifier ([a-zA-Z_][a-zA-Z0-9_]*) — "
+                        f"downstream tooling keys on it",
                     )
-                present = {
-                    kk.value for kk in node.keys if _str_const(kk)
-                }
-                missing = [
-                    f for f in self._SCALE_FIELDS if f not in present
-                ]
-                if missing:
-                    self.report(
-                        v,
-                        f"scale record missing field(s) "
-                        f"{'/'.join(missing)} — the autoscale smoke "
-                        f"asserts an up AND a down were observed by "
-                        f"exactly the action/reason fields",
-                    )
-                self._check_literal_member(
-                    node, "action", self._SCALE_ACTIONS,
-                    "scale record 'action'",
-                    "the smoke's up/down asserts and summarize only "
-                    "know these actions",
-                )
-            elif v.value == "frame_drop":
-                if not self._in_module("fleet/transport.py"):
-                    self.report(
-                        v,
-                        "raw frame_drop record built outside "
-                        "fleet/transport.py — a drop record is the "
-                        "transport's proof a frame was validated and "
-                        "condemned; a hand-rolled one claims enforcement "
-                        "that never ran",
-                    )
-                self._check_literal_member(
-                    node, "reason", self._DROP_REASONS,
-                    "frame_drop record 'reason'",
-                    "drop triage greps exactly this reason set; an "
-                    "unknown reason is an invisible wire failure",
-                )
-            elif v.value == "notify":
-                if not self._in_module("telemetry/alert_router.py"):
-                    self.report(
-                        v,
-                        "raw notify record built outside "
-                        "telemetry/alert_router.py — a notify record "
-                        "claims the dedup/silence/rate pipeline ran; a "
-                        "hand-rolled one forges a delivery the on-call "
-                        "never received; go through AlertRouter",
-                    )
-                self._check_literal_member(
-                    node, "status", self._NOTIFY_STATUSES,
-                    "notify record 'status'",
-                    "the console's delivery counts and the CI egress "
-                    "smoke classify by exactly the "
-                    "sent/failed/silenced/deduped/escalated alphabet",
-                )
-            elif v.value == "ship":
-                if not self._in_module("telemetry/tsdb.py"):
-                    self.report(
-                        v,
-                        "raw ship record built outside "
-                        "telemetry/tsdb.py — a ship record is the "
-                        "shipper's proof a block's digest was verified "
-                        "into the archive manifest; a hand-rolled one "
-                        "claims history that was never tiered out",
-                    )
-                self._check_literal_member(
-                    node, "op", self._SHIP_OPS,
-                    "ship record 'op'",
-                    "retention triage greps exactly the "
-                    "shipped/skipped/verify_failed op set",
-                )
-            elif v.value == "deploy":
-                if "/deploy/" not in self.ctx.path.replace("\\", "/"):
-                    self.report(
-                        v,
-                        "raw deploy record built outside "
-                        "progen_tpu/deploy/ — the deploy ledger is the "
-                        "controller's resume authority; a hand-rolled "
-                        "record forges a canary/promote/rollback "
-                        "decision the controller never made; go "
-                        "through DeployLedger",
-                    )
-                self._check_literal_member(
-                    node, "op", self._DEPLOY_OPS,
-                    "deploy record 'op'",
-                    "the deployment smoke and the kill-matrix "
-                    "convergence asserts grep exactly the observed/"
-                    "canary/probe/promote/rollback/converged op set",
-                )
+            elif grammar.scope == "emit":
+                # dict-scope grammars are handled by visit_Dict (which
+                # also sees this literal) — checking both would double-
+                # report
+                self._check_grammar(d, v, grammar)
+
+    # ----- bespoke checks (not per-ev grammars) ---------------------------
 
     def _check_span_name(self, node: ast.Call) -> None:
         name_arg = node.args[0]
@@ -371,229 +185,6 @@ class TelemetryHygieneRule(Rule):
             f"so the trace/summarize tooling groups on a bounded, "
             f"greppable set; put varying data in span attrs instead",
         )
-
-    def _check_event_dict(self, d: ast.Dict) -> None:
-        for k, v in zip(d.keys, d.values):
-            if not (_str_const(k) and k.value == "ev"):
-                continue
-            if not _str_const(v):
-                self.report(
-                    v,
-                    "event 'ev' tag must be a string literal so event "
-                    "streams stay greppable",
-                )
-                continue
-            if v.value in ("B", "E") and not self._in_spans_module():
-                self.report(
-                    v,
-                    "raw B/E span record emitted directly — use the "
-                    "span() context manager, whose finally-block "
-                    "guarantees the matching E even on exceptions",
-                )
-            elif v.value == "req":
-                if not (
-                    self._in_scheduler_module()
-                    or self._in_module("serving/router.py")
-                ):
-                    self.report(
-                        v,
-                        "raw async req record emitted outside "
-                        "serving/scheduler.py or serving/router.py — "
-                        "they own the request lifecycle grammar (every "
-                        "'b' must get its 'e' on all exit paths); go "
-                        "through Scheduler/Router, not hand-rolled "
-                        "records",
-                    )
-                self._check_req_ph(d)
-                self._check_trace_key(d)
-            elif v.value == "route":
-                if not self._in_module("serving/router.py"):
-                    self.report(
-                        v,
-                        "raw route record emitted outside "
-                        "serving/router.py — the routing-decision "
-                        "grammar is what summarize's router section and "
-                        "the CI failover smoke key on; go through "
-                        "Router, not hand-rolled records",
-                    )
-                self._check_literal_member(
-                    d, "status",
-                    ("dispatched", "handoff", "shed", "replica_down"),
-                    "route record 'status'",
-                    "an unknown status is invisible to the router "
-                    "table in summarize and to the failover smoke",
-                )
-                self._check_trace_key(d)
-            elif v.value == "journal":
-                if not self._in_module("serving/journal.py"):
-                    self.report(
-                        v,
-                        "raw journal record emitted outside "
-                        "serving/journal.py — the replay journal's op "
-                        "grammar is the crash-recovery contract; go "
-                        "through RequestJournal, not hand-rolled "
-                        "records",
-                    )
-                self._check_literal_member(
-                    d, "op", ("accept", "token", "done"),
-                    "journal record 'op'",
-                    "replay_requests drops records it can't parse — "
-                    "an unknown op is silently lost work",
-                )
-            elif v.value == "reload":
-                if not self._in_module("serving/reload.py"):
-                    self.report(
-                        v,
-                        "raw reload record emitted outside "
-                        "serving/reload.py — reload status records are "
-                        "what the zero-downtime smoke asserts on; go "
-                        "through WeightReloader, not hand-rolled "
-                        "records",
-                    )
-                self._check_literal_member(
-                    d, "status", ("staged", "committed", "rejected"),
-                    "reload record 'status'",
-                    "anything else reads as a torn reload to the "
-                    "zero-downtime tooling",
-                )
-            elif v.value == "score":
-                if "/workloads/" not in self.ctx.path.replace("\\", "/"):
-                    self.report(
-                        v,
-                        "raw score record emitted outside "
-                        "progen_tpu/workloads/ — the batch-score "
-                        "journal's op grammar is the resume/progress "
-                        "contract the CI workloads smoke greps; go "
-                        "through ScoreJournal, not hand-rolled records",
-                    )
-                self._check_literal_member(
-                    d, "op", ("start", "resume", "batch", "skip", "done"),
-                    "score record 'op'",
-                    "an unknown op is invisible to the scoring progress "
-                    "tooling and the resume smoke",
-                )
-            elif v.value == "prefix_cache":
-                if not self._in_module("serving/prefix_cache.py"):
-                    self.report(
-                        v,
-                        "raw prefix_cache record emitted outside "
-                        "serving/prefix_cache.py — cache reuse events "
-                        "are what the serving smoke's hit assertion and "
-                        "summarize key on; go through PrefixCache, not "
-                        "hand-rolled records",
-                    )
-                self._check_literal_member(
-                    d, "op", ("hit", "miss", "evict"),
-                    "prefix_cache record 'op'",
-                    "an unknown op is invisible to the cache-reuse "
-                    "accounting and the serving smoke",
-                )
-            elif v.value == "slo":
-                if not self._in_module("telemetry/slo.py"):
-                    self.report(
-                        v,
-                        "raw slo record emitted outside "
-                        "telemetry/slo.py — objective-state transitions "
-                        "are the watchtower's judgment, keyed on by the "
-                        "SLO gate and summarize; go through SloWatch, "
-                        "not hand-rolled records",
-                    )
-                self._check_literal_member(
-                    d, "state", ("ok", "warn", "burning", "resolved"),
-                    "slo record 'state'",
-                    "the gate's exit-code contract and the transition "
-                    "grammar only know these states",
-                )
-            elif v.value == "flight":
-                if not self._in_module("telemetry/flight.py"):
-                    self.report(
-                        v,
-                        "raw flight record emitted outside "
-                        "telemetry/flight.py — a 'dumped' record is the "
-                        "recorder's receipt that a sealed, digest-valid "
-                        "black box reached disk; a hand-rolled one "
-                        "claims forensic evidence that was never "
-                        "written; go through FlightRecorder",
-                    )
-                self._check_literal_member(
-                    d, "op", ("armed", "dumped", "truncated"),
-                    "flight record 'op'",
-                    "the forensics smoke and query --trace grep "
-                    "exactly the armed/dumped/truncated op set",
-                )
-            elif v.value == "profile":
-                if not self._in_module("telemetry/flight.py"):
-                    self.report(
-                        v,
-                        "raw profile record emitted outside "
-                        "telemetry/flight.py — the pin watcher's "
-                        "request/ack ledger is the proof a jax.profiler "
-                        "window actually ran (and was rate-limited); go "
-                        "through request_profile/ProfilePinWatcher",
-                    )
-                self._check_literal_member(
-                    d, "op",
-                    ("requested", "started", "stopped", "rejected"),
-                    "profile record 'op'",
-                    "the on-demand profiling smoke pairs requested/"
-                    "started/stopped and triages rejected — an unknown "
-                    "op is an invisible window",
-                )
-            elif not _PROM_NAME_RE.match(v.value):
-                self.report(
-                    v,
-                    f"event tag '{v.value}' is not a clean identifier "
-                    f"([a-zA-Z_][a-zA-Z0-9_]*) — downstream tooling "
-                    f"keys on it",
-                )
-
-    def _check_req_ph(self, d: ast.Dict) -> None:
-        for k, v in zip(d.keys, d.values):
-            if not (_str_const(k) and k.value == "ph"):
-                continue
-            if _str_const(v) and v.value not in ("b", "n", "e"):
-                self.report(
-                    v,
-                    f"req record 'ph' is '{v.value}' — async trace "
-                    f"events only use 'b' (begin), 'n' (instant), "
-                    f"'e' (end); anything else is dropped by the "
-                    f"trace builder",
-                )
-
-    # misspellings of the one blessed trace-context key: the stitcher's
-    # journey grouping greps records for exactly "trace_id", so a hop
-    # written under any of these never joins its journey
-    _TRACE_MISSPELLINGS = (
-        "trace", "traceid", "traceId", "trace_ctx", "trace_context",
-        "span_id", "spanid",
-    )
-
-    def _check_trace_key(self, d: ast.Dict) -> None:
-        for k in d.keys:
-            if _str_const(k) and k.value in self._TRACE_MISSPELLINGS:
-                self.report(
-                    k,
-                    f"trace-context key '{k.value}' — the blessed "
-                    f"spelling is 'trace_id' (stitch journey grouping "
-                    f"and the kill-matrix contiguity assert grep "
-                    f"exactly that key); a misspelled hop silently "
-                    f"falls out of its journey",
-                )
-
-    def _check_literal_member(self, d: ast.Dict, field: str,
-                              allowed: tuple, what: str,
-                              why: str) -> None:
-        """A literal ``field`` value in the record must come from the
-        ``allowed`` alphabet (non-literals are the emitter's problem)."""
-        for k, v in zip(d.keys, d.values):
-            if not (_str_const(k) and k.value == field):
-                continue
-            if _str_const(v) and v.value not in allowed:
-                self.report(
-                    v,
-                    f"{what} is '{v.value}' — must be one of "
-                    f"{'/'.join(allowed)}: {why}",
-                )
 
     def _check_prom_name(self, node, name: str) -> None:
         if not _PROM_NAME_RE.match(name):

@@ -26,9 +26,14 @@ from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from progen_tpu.analysis.core import Finding, ModuleContext
+from progen_tpu.analysis.project import ProjectContext, default_text_files
+from progen_tpu.analysis.rules_chaos import ChaosDriftRule
 from progen_tpu.analysis.rules_donation import DonationRule
+from progen_tpu.analysis.rules_durability import DurabilityRule
 from progen_tpu.analysis.rules_effects import TracedEffectsRule
+from progen_tpu.analysis.rules_grammar_consumers import GrammarConsumerRule
 from progen_tpu.analysis.rules_host_sync import HostSyncRule
+from progen_tpu.analysis.rules_locks import LockDisciplineRule
 from progen_tpu.analysis.rules_recompile import RecompileRule
 from progen_tpu.analysis.rules_rng import RngReuseRule
 from progen_tpu.analysis.rules_telemetry import TelemetryHygieneRule
@@ -41,9 +46,19 @@ RULES = (
     RecompileRule,
     TracedEffectsRule,
     TelemetryHygieneRule,
+    DurabilityRule,
+    LockDisciplineRule,
+    GrammarConsumerRule,
 )
 
-RULE_DOCS: Dict[str, str] = {r.id: r.doc for r in RULES}
+# whole-project rules: one instance lints the ProjectContext built
+# over every discovered module (plus tier1.yml and the docs), after
+# the per-module rules have run
+PROJECT_RULES = (ChaosDriftRule,)
+
+RULE_DOCS: Dict[str, str] = {
+    r.id: r.doc for r in RULES + PROJECT_RULES
+}
 
 _SKIP_DIR_NAMES = {
     "__pycache__", ".git", ".ruff_cache", "node_modules", "build",
@@ -103,29 +118,55 @@ def discover_files(paths: Sequence) -> List[Path]:
     return files
 
 
-def lint_file(path, rel_to: Optional[Path] = None,
-              rules=RULES) -> List[Finding]:
-    """All findings for one file. Syntax errors surface as a single
-    PGL000 error finding rather than crashing the run."""
+def _parse_module(path, rel_to: Optional[Path] = None):
+    """(ctx, None) or (None, PGL000 finding) for a syntax error."""
     source = Path(path).read_text()
     try:
         ctx = ModuleContext(path, source, rel_to=rel_to)
     except SyntaxError as e:
-        return [
-            Finding(
-                rule="PGL000",
-                severity="error",
-                path=str(path),
-                line=e.lineno or 0,
-                col=e.offset or 0,
-                message=f"syntax error: {e.msg}",
-            )
-        ]
+        return None, Finding(
+            rule="PGL000",
+            severity="error",
+            path=str(path),
+            line=e.lineno or 0,
+            col=e.offset or 0,
+            message=f"syntax error: {e.msg}",
+        )
     TracedIndex(ctx)
+    return ctx, None
+
+
+def _run_module_rules(ctx: ModuleContext, rules) -> List[Finding]:
     findings: List[Finding] = []
     for rule_cls in rules:
         findings.extend(rule_cls(ctx).run())
     findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return findings
+
+
+def _run_project_rules(contexts, text_files,
+                       project_rules) -> List[Finding]:
+    if not project_rules or not contexts:
+        return []
+    project = ProjectContext.build(contexts, text_files=text_files)
+    findings: List[Finding] = []
+    for rule_cls in project_rules:
+        findings.extend(rule_cls(project).run())
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def lint_file(path, rel_to: Optional[Path] = None, rules=RULES,
+              project_rules=PROJECT_RULES) -> List[Finding]:
+    """All findings for one file — including project rules run over a
+    single-file ProjectContext, so the fixture corpora exercise them
+    standalone. Syntax errors surface as a single PGL000 error finding
+    rather than crashing the run."""
+    ctx, err = _parse_module(path, rel_to=rel_to)
+    if err is not None:
+        return [err]
+    findings = _run_module_rules(ctx, rules)
+    findings.extend(_run_project_rules([ctx], (), project_rules))
     return findings
 
 
@@ -134,12 +175,27 @@ def lint_paths(
     baseline: Optional[Sequence[dict]] = None,
     rel_to: Optional[Path] = None,
     rules=RULES,
+    project_rules=PROJECT_RULES,
 ) -> Tuple[List[Finding], List[Finding]]:
     """(new_findings, baselined_findings) over every file under
-    ``paths``. The exit-code contract is ``fail iff new_findings``."""
+    ``paths``. Modules are parsed ONCE; the per-module rules run on
+    each, then the whole-project rules run on a ProjectContext built
+    over all of them plus the repo's CI workflows and markdown docs.
+    The exit-code contract is ``fail iff new_findings``."""
     all_findings: List[Finding] = []
+    contexts: List[ModuleContext] = []
     for f in discover_files(paths):
-        all_findings.extend(lint_file(f, rel_to=rel_to, rules=rules))
+        ctx, err = _parse_module(f, rel_to=rel_to)
+        if err is not None:
+            all_findings.append(err)
+            continue
+        contexts.append(ctx)
+        all_findings.extend(_run_module_rules(ctx, rules))
+    all_findings.extend(
+        _run_project_rules(
+            contexts, default_text_files(paths), project_rules
+        )
+    )
     if not baseline:
         return all_findings, []
     new, matched = [], []

@@ -57,11 +57,39 @@ from progen_tpu.analysis import (
     "--list-rules", is_flag=True, default=False,
     help="print the rule table and exit",
 )
-def main(paths, baseline_path, no_baseline, json_out, list_rules):
+@click.option(
+    "--registry-dump", is_flag=True, default=False,
+    help="print the generated chaos-site + event-grammar registry "
+    "block (paste between the registry markers in README.md) and exit",
+)
+@click.option(
+    "--registry-check",
+    "registry_check_path",
+    type=click.Path(exists=True, dir_okay=False),
+    default=None,
+    help="verify the registry block committed in the given markdown "
+    "file matches the code; exit 1 on drift",
+)
+def main(paths, baseline_path, no_baseline, json_out, list_rules,
+         registry_dump, registry_check_path):
     """Lint PATHS (files or directories) with the PGL rule set."""
     if list_rules:
         for rule_id in sorted(RULE_DOCS):
             click.echo(f"{rule_id}  {RULE_DOCS[rule_id]}")
+        return
+    if registry_dump:
+        from progen_tpu.analysis.registry import render_registry_markdown
+
+        click.echo(render_registry_markdown())
+        return
+    if registry_check_path:
+        from progen_tpu.analysis.registry import registry_check
+
+        problem = registry_check(registry_check_path)
+        if problem is not None:
+            click.echo(f"error: {problem}", err=True)
+            sys.exit(1)
+        click.echo(f"{registry_check_path}: registry block up to date")
         return
     if not paths:
         raise click.UsageError("no paths given (try: progen-tpu-lint .)")

@@ -148,7 +148,10 @@ class Replica:
             return False
         self.dir.mkdir(parents=True, exist_ok=True)
         tmp = self.pin_path.with_name(self.pin_path.name + ".tmp")
-        tmp.write_text(ckpt + "\n")
+        with tmp.open("w") as f:
+            f.write(ckpt + "\n")
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, self.pin_path)
         return True
 

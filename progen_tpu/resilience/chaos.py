@@ -81,6 +81,14 @@ Fleet targets (progen_tpu/fleet/ — TCP transport and autoscaler):
                             the fleet (the router CLI skips the tick),
                             and ``kill@N`` dies inside the decision.
 
+Workload targets (progen_tpu/workloads/scoring.py):
+
+  * ``score/batch``     — top of each batch-scoring step, after the
+                          resume skip-scan (``kill@N`` = die mid-sweep:
+                          the fsync'd shard journal must make the
+                          resumed run re-score nothing and drop
+                          nothing — the CI workloads smoke's contract).
+
 Forensics targets (progen_tpu/telemetry/flight.py):
 
   * ``flight/dump``     — span entry of a flight-recorder dump
@@ -129,7 +137,8 @@ KNOWN_TARGETS = frozenset({
     "train/loss",
     # direct maybe_inject sites
     "autoscaler/decide", "router/connect", "router/dispatch",
-    "serve/decode", "transport/accept", "transport/frame",
+    "score/batch", "serve/decode", "transport/accept",
+    "transport/frame",
 })
 
 _WARNED_UNKNOWN: set = set()

@@ -205,7 +205,10 @@ class WeightReloader:
         ack = self.pin_path.with_name(self.pin_path.name + ".ack")
         tmp = ack.with_name(ack.name + ".tmp")
         try:
-            tmp.write_text(json.dumps(rec))
+            with tmp.open("w") as f:
+                f.write(json.dumps(rec))
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, ack)
         except OSError:
             return

@@ -395,7 +395,10 @@ def request_profile(pin_path, duration_s: Optional[float] = None,
     content = token if duration_s is None else f"{token} {duration_s:g}"
     pin_path.parent.mkdir(parents=True, exist_ok=True)
     tmp = pin_path.with_name(pin_path.name + ".tmp")
-    tmp.write_text(content)
+    with tmp.open("w") as f:
+        f.write(content)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, pin_path)
     get_telemetry().emit({
         "ev": "profile", "ts": time.time(), "op": "requested",
@@ -460,7 +463,10 @@ class ProfilePinWatcher:
         ack = self.pin_path.with_name(self.pin_path.name + ".ack")
         tmp = ack.with_name(ack.name + ".tmp")
         try:
-            tmp.write_text(json.dumps(rec))
+            with tmp.open("w") as f:
+                f.write(json.dumps(rec))
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, ack)
         except OSError:
             return

@@ -136,7 +136,10 @@ class BlockShipper:
 
     def _save_manifest(self) -> None:
         tmp = self.manifest_path.with_suffix(".tmp")
-        tmp.write_text(json.dumps(self._manifest, sort_keys=True))
+        with tmp.open("w") as f:
+            f.write(json.dumps(self._manifest, sort_keys=True))
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, self.manifest_path)
 
     def _record(self, op: str, seq: int, level: int, name: str,

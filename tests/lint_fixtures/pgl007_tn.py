@@ -1,0 +1,54 @@
+"""PGL007 true negatives: expected findings: 0."""
+
+import json
+import os
+from pathlib import Path
+
+
+def publish_manifest(out_dir, blocks):
+    # atomic publish: tmp + fsync + replace
+    manifest_path = out_dir / "manifest.json"
+    tmp = manifest_path.with_suffix(".tmp")
+    with tmp.open("w") as f:
+        f.write(json.dumps(blocks))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, manifest_path)
+
+
+def append_with_fsync(ledger_path, rec):
+    f = open(ledger_path, "a")
+    f.write(json.dumps(rec) + "\n")
+    f.flush()
+    os.fsync(f.fileno())
+    f.close()
+
+
+def read_manifest(out_dir):
+    # reads are unconstrained
+    manifest_path = out_dir / "manifest.json"
+    with open(manifest_path) as f:
+        return json.load(f)
+
+
+def scratch_report(out_dir, text):
+    # not a durable class of path: no discipline demanded
+    report_path = out_dir / "report.txt"
+    report_path.write_text(text)
+
+
+def move_foreign_file(src, ack_path):
+    # src was not written here (a subprocess produced it) — the
+    # publish-without-fsync check only fires on same-function writes
+    os.replace(src, ack_path)
+
+
+class WalJournal:
+    def __init__(self, p):
+        self.path = Path(p)
+        self._f = self.path.open("a")
+
+    def emit(self, rec):
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+        os.fsync(self._f.fileno())

@@ -1,0 +1,44 @@
+"""PGL007 true positives: durable-write discipline violations.
+
+Expected: 5.
+"""
+
+import json
+import os
+from pathlib import Path
+
+
+def overwrite_manifest(out_dir):
+    manifest_path = out_dir / "manifest.json"
+    with open(manifest_path, "w") as f:  # TP: direct overwrite
+        json.dump({"blocks": []}, f)
+
+
+def overwrite_meta(base):
+    meta = base / "meta.json"
+    meta.write_text(json.dumps({"step": 1}))  # TP: direct overwrite
+
+
+def append_no_fsync(out):
+    f = open(str(out) + ".jsonl", "a")
+    f.write(json.dumps({"op": "x"}) + "\n")  # TP: fsync-less append
+    f.flush()
+    f.close()
+
+
+def publish_without_fsync(pin_path, name):
+    tmp = pin_path.with_name(pin_path.name + ".tmp")
+    tmp.write_text(name + "\n")
+    os.replace(tmp, pin_path)  # TP: rename publish, tmp never fsynced
+
+
+class CrashJournal:
+    """Journal by name: its path is durable however it is spelled."""
+
+    def __init__(self, p):
+        self.path = Path(p)
+        self._f = self.path.open("a")
+
+    def emit(self, rec):
+        self._f.write(json.dumps(rec) + "\n")  # TP: flush is not fsync
+        self._f.flush()

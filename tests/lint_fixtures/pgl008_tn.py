@@ -1,0 +1,66 @@
+"""PGL008 true negatives: expected findings: 0."""
+
+import sys
+import threading
+import time
+
+EMIT_TAPS = []
+_DUMP_LOCK = threading.Lock()
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0  # __init__ is exempt: no concurrent aliases
+        self._label = ""
+
+    def add(self, n):
+        with self._lock:
+            self._count += n
+
+    def reset(self):
+        with self._lock:
+            self._count = 0
+
+    def rename(self, label):
+        self._label = label  # never lock-guarded anywhere: no verdict
+
+
+class Recorder:
+    """The fixed flight-recorder shape: non-blocking acquire, shed on
+    contention, pure mutation under the lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ring = []
+        EMIT_TAPS.append(self.tap)
+
+    def tap(self, rec):
+        with self._lock:
+            self._ring.append(rec)  # mutation, not I/O
+        if len(self._ring) > 8:
+            self.dump()
+
+    def dump(self):
+        if not self._lock.acquire(blocking=False):
+            return  # shed: someone is already dumping
+        try:
+            self._ring.clear()
+        finally:
+            self._lock.release()
+
+
+def not_a_handler():
+    # blocking acquire outside any handler-reachable code is fine
+    _DUMP_LOCK.acquire()
+    try:
+        time.sleep(0.0)
+    finally:
+        _DUMP_LOCK.release()
+
+
+def _quiet_hook(exc_type, exc, tb):
+    sys.__excepthook__(exc_type, exc, tb)
+
+
+sys.excepthook = _quiet_hook

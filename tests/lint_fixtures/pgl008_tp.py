@@ -1,0 +1,65 @@
+"""PGL008 true positives: lock-discipline violations.
+
+Expected: 4 — one bare write of a lock-guarded attribute, and the
+flight-dump deadlock family in tap/excepthook/signal contexts.
+"""
+
+import signal
+import sys
+import threading
+import time
+
+EMIT_TAPS = []
+_DUMP_LOCK = threading.Lock()
+STATE_LOCK = threading.Lock()
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def add(self, n):
+        with self._lock:
+            self._count += n
+
+    def reset(self):
+        self._count = 0  # TP: guarded in add(), bare here
+
+
+class Recorder:
+    """The PR 19 flight-recorder deadlock shape: the tap fires inside
+    an emit that may already hold the lock, and dump blocks on it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ring = []
+        EMIT_TAPS.append(self.tap)
+
+    def tap(self, rec):
+        self._ring.append(rec)
+        if len(self._ring) > 8:
+            self.dump()
+
+    def dump(self):
+        self._lock.acquire()  # TP: blocking acquire, tap-reachable
+        try:
+            self._ring.clear()
+        finally:
+            self._lock.release()
+
+
+def _hook(exc_type, exc, tb):
+    with _DUMP_LOCK:
+        time.sleep(0.1)  # TP: I/O while holding a lock in excepthook
+
+
+sys.excepthook = _hook
+
+
+def _on_term(signum, frame):
+    STATE_LOCK.acquire()  # TP: blocking acquire in a signal handler
+    STATE_LOCK.release()
+
+
+signal.signal(signal.SIGTERM, _on_term)

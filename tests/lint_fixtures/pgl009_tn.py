@@ -1,0 +1,19 @@
+"""PGL009 true negatives: expected findings: 0."""
+
+KNOWN_TARGETS = frozenset({
+    "ok/site",
+    "retry/site",
+})
+
+
+def do_work(span, retry_call):
+    with span("ok/site"):
+        pass
+    retry_call(lambda: None, label="retry/site")
+
+
+KILL_MATRIX = [
+    "ok/site:kill@1",
+    "retry/site:fail@2",
+    "dead/site:kill@1",  # progen: ignore[PGL009] - suppression demo
+]

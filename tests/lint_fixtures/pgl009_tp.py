@@ -1,0 +1,26 @@
+"""PGL009 true positives: chaos-site drift. Expected: 3.
+
+The KNOWN_TARGETS declaration below puts the injection surface in
+scope for the linter, the way resilience/chaos.py does in the real
+package.
+"""
+
+KNOWN_TARGETS = frozenset({
+    "fix/site",
+    "gone/site",  # TP: declared but nothing installs it
+})
+
+
+def do_work(span):
+    with span("fix/site"):
+        pass
+    with span("extra/site"):  # installed but undeclared (flagged at ref)
+        pass
+
+
+# A fake kill matrix the way the tier-1 tests spell theirs:
+KILL_MATRIX = [
+    "ghost/site:kill@1",  # TP: no site by this name exists
+    "extra/site:kill@2",  # TP: installed in do_work, not in KNOWN_TARGETS
+    "fix/site:fail@3",
+]

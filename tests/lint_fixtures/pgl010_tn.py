@@ -1,0 +1,53 @@
+"""PGL010 true negatives: expected findings: 0."""
+
+
+def fold_journal(recs):
+    out = []
+    for rec in recs:
+        op = rec.get("op")
+        if op == "accept":  # exhaustive: all journal ops handled
+            out.append(rec)
+        elif op == "token":
+            out.append(rec)
+        elif op == "done":
+            out.append(None)
+    return out
+
+
+def count_dispatched(recs):
+    n = 0
+    for rec in recs:
+        status = rec.get("status")
+        if status == "dispatched":  # single-value filter: not a dispatch
+            n += 1
+    return n
+
+
+def route_or_default(recs):
+    for rec in recs:
+        if rec["status"] == "dispatched":  # partial but has a default
+            yield "d"
+        elif rec["status"] == "handoff":
+            yield "h"
+        else:
+            yield "?"
+
+
+def safety_valve(recs):
+    for rec in recs:
+        # {'warn', 'burning'} is a subset of both the slo and alert
+        # state enums: binding is ambiguous, the rule stays quiet
+        state = rec.get("state")
+        if state == "warn":
+            yield rec
+        elif state == "burning":
+            yield rec
+
+
+def not_a_grammar_field(recs):
+    for rec in recs:
+        flavor = rec.get("flavor")  # 'flavor' is not a dispatch field
+        if flavor == "sweet":
+            yield 1
+        elif flavor == "sour":
+            yield 2

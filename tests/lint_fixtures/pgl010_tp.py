@@ -1,0 +1,44 @@
+"""PGL010 true positives: non-exhaustive event-grammar consumers.
+
+Expected: 4.
+"""
+
+
+def fold_journal(recs):
+    out = []
+    for rec in recs:
+        op = rec.get("op")
+        if op == "accept":  # TP: journal ops, 'done' unhandled, no else
+            out.append(rec)
+        elif op == "token":
+            out.append(rec)
+    return out
+
+
+def count_routes(recs):
+    n = 0
+    for rec in recs:
+        if rec["status"] == "dispatched":  # TP: 'teleported' not a route status
+            n += 1
+        elif rec["status"] == "teleported":
+            n -= 1
+    return n
+
+
+def ship_verdict(rec):
+    match rec.get("op"):  # TP: ship ops, 'verify_failed' unhandled
+        case "shipped":
+            return 1
+        case "skipped":
+            return 0
+
+
+def slo_transitions(recs):
+    for rec in recs:
+        if rec.get("ev") != "slo":
+            continue
+        state = rec.get("state")
+        if state == "ok":  # TP: slo states, burning/resolved unhandled
+            yield rec
+        elif state == "warn":
+            yield rec

@@ -9,9 +9,11 @@ from pathlib import Path
 import pytest
 
 from progen_tpu.analysis import (
+    PROJECT_RULES,
     RULE_DOCS,
     RULES,
     BaselineError,
+    ProjectContext,
     discover_files,
     lint_file,
     lint_paths,
@@ -30,6 +32,10 @@ EXPECTED_TP = {
     "PGL004": 4,
     "PGL005": 2,
     "PGL006": 51,
+    "PGL007": 5,
+    "PGL008": 4,
+    "PGL009": 3,
+    "PGL010": 4,
 }
 
 
@@ -53,7 +59,7 @@ class TestFixtureCorpus:
         assert findings == [], [f.render() for f in findings]
 
     def test_every_rule_has_fixtures(self):
-        ids = {r.id for r in RULES}
+        ids = {r.id for r in RULES} | {r.id for r in PROJECT_RULES}
         assert ids == set(EXPECTED_TP)
         for rule_id in ids:
             assert (FIXTURES / f"{rule_id.lower()}_tp.py").is_file()
@@ -65,6 +71,87 @@ class TestFixtureCorpus:
         assert f.line > 0 and f.func == "loss_with_sync"
         assert "pgl001_tp.py" in f.render()
         assert f.to_json()["rule"] == "PGL001"
+
+
+class TestProjectContext:
+    """Index correctness for the cross-module pass the project rules
+    (PGL009) share: installed sites, KNOWN_TARGETS, chaos references."""
+
+    def _ctx(self, tmp_path, name, src):
+        from progen_tpu.analysis.core import ModuleContext
+
+        p = tmp_path / name
+        p.write_text(src)
+        return ModuleContext(p, src)
+
+    def test_site_index_covers_all_installer_shapes(self, tmp_path):
+        ctx = self._ctx(tmp_path, "m.py", (
+            "def work(span, _span, retry_call, retryable, maybe_inject):\n"
+            "    with span('a/plain'):\n"
+            "        pass\n"
+            "    with _span('a/aliased'):\n"
+            "        pass\n"
+            "    retry_call(lambda: 0, label='a/retry')\n"
+            "    retryable('a/retryable')\n"
+            "    maybe_inject('a/inject')\n"
+            "    span(dynamic_name)\n"
+        ))
+        proj = ProjectContext.build([ctx])
+        assert set(proj.sites) == {
+            "a/plain", "a/aliased", "a/retry", "a/retryable", "a/inject",
+        }
+        path, line = proj.sites["a/plain"][0]
+        assert path.endswith("m.py") and line == 2
+
+    def test_known_targets_declaration_indexed(self, tmp_path):
+        ctx = self._ctx(tmp_path, "chaos.py", (
+            "KNOWN_TARGETS = frozenset({'x/one', 'x/two'})\n"
+        ))
+        proj = ProjectContext.build([ctx])
+        assert proj.declaration is not None
+        assert set(proj.declared) == {"x/one", "x/two"}
+
+    def test_chaos_refs_from_strings_fstrings_comments(self, tmp_path):
+        ctx = self._ctx(tmp_path, "t.py", (
+            # progen: ignore[PGL009] - fixture source under test
+            "SPEC = 'x/one:kill@2'\n"
+            "def env(n):\n"
+            "    return f'x/two:fail@{n}'\n"
+            "# export PROGEN_CHAOS=x/three:0.5\n"
+        ))
+        proj = ProjectContext.build([ctx])
+        assert [(r.target, r.line) for r in proj.chaos_refs] == [
+            ("x/one", 1), ("x/two", 3), ("x/three", 4),
+        ]
+
+    def test_chaos_refs_from_text_files(self, tmp_path):
+        yml = tmp_path / "ci.yml"
+        yml.write_text(
+            # progen: ignore[PGL009] - fixture source under test
+            "env:\n  PROGEN_CHAOS: 'x/site:kill@1'\n"
+        )
+        proj = ProjectContext.build([], [yml])
+        assert [(r.target, r.line) for r in proj.chaos_refs] == [
+            ("x/site", 2),
+        ]
+        assert proj.chaos_refs[0].ctx is None  # not suppressible, bare loc
+
+    def test_spec_grammar_rejects_lookalikes(self, tmp_path):
+        ctx = self._ctx(tmp_path, "t.py", (
+            "A = 'path/to/file.py:12'\n"          # line ref, not a spec
+            "B = 'https://host/a:8080'\n"          # port, not a spec
+            "C = 'a/b:kill@x'\n"                   # malformed count
+            "D = 'noslash:kill@1'\n"               # target needs a '/'
+        ))
+        proj = ProjectContext.build([ctx])
+        assert proj.chaos_refs == []
+
+    def test_default_text_files_finds_workflows_and_docs(self):
+        from progen_tpu.analysis import default_text_files
+
+        files = {p.name for p in default_text_files([REPO / "progen_tpu"])}
+        assert "tier1.yml" in files
+        assert "README.md" in files
 
 
 class TestSuppressions:
@@ -263,6 +350,84 @@ class TestCli:
             [sys.executable, "-c", code], capture_output=True
         )
         assert proc.returncode == 0, proc.stderr.decode()
+
+
+class TestRegistry:
+    """The generated README sections: the dump renders both registries
+    and the committed copy is drift-locked (same gate CI runs)."""
+
+    def test_dump_contains_both_registries(self):
+        from progen_tpu.analysis.registry import render_registry_markdown
+
+        block = render_registry_markdown()
+        assert "### Chaos sites" in block
+        assert "### Event grammars" in block
+        # a site every PR since the chaos harness has kept installed
+        assert "`ckpt/save`" in block
+        # an event grammar with its enum alphabet
+        assert "accept/token/done" in block
+
+    def test_chaos_table_lists_every_declared_target(self):
+        from progen_tpu.analysis.registry import (
+            build_project,
+            render_chaos_sites_markdown,
+            repo_root,
+        )
+
+        root = repo_root()
+        proj = build_project([root / "progen_tpu"], rel_to=root)
+        table = render_chaos_sites_markdown(proj)
+        assert proj.declared, "KNOWN_TARGETS parsed from chaos.py"
+        for target in proj.declared:
+            assert f"| `{target}` |" in table
+
+    def test_committed_readme_block_matches_code(self):
+        from progen_tpu.analysis.registry import registry_check
+
+        assert registry_check(REPO / "README.md") is None
+
+    def test_check_flags_stale_block(self, tmp_path):
+        from progen_tpu.analysis.registry import (
+            REGISTRY_BEGIN,
+            REGISTRY_END,
+            registry_check,
+        )
+
+        doc = tmp_path / "doc.md"
+        doc.write_text(
+            f"{REGISTRY_BEGIN}\nstale hand-edited content\n{REGISTRY_END}\n"
+        )
+        problem = registry_check(doc)
+        assert problem is not None and "stale" in problem
+        assert registry_check(tmp_path / "doc.md") is not None
+
+    def test_check_flags_missing_markers(self, tmp_path):
+        from progen_tpu.analysis.registry import registry_check
+
+        doc = tmp_path / "doc.md"
+        doc.write_text("no markers here\n")
+        problem = registry_check(doc)
+        assert problem is not None and "markers" in problem
+
+    def test_cli_dump_and_check(self, tmp_path):
+        from click.testing import CliRunner
+
+        from progen_tpu.cli.lint import main
+
+        runner = CliRunner()
+        dump = runner.invoke(main, ["--registry-dump"])
+        assert dump.exit_code == 0 and "### Chaos sites" in dump.output
+
+        check = runner.invoke(main, ["--registry-check",
+                                     str(REPO / "README.md")])
+        assert check.exit_code == 0, check.output
+
+        stale = tmp_path / "doc.md"
+        stale.write_text(
+            "<!-- registry:begin -->\nold\n<!-- registry:end -->\n"
+        )
+        bad = runner.invoke(main, ["--registry-check", str(stale)])
+        assert bad.exit_code == 1
 
 
 def _mix_stderr_supported() -> bool:

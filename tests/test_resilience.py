@@ -205,7 +205,8 @@ class TestChaosRules:
         from progen_tpu import telemetry
         from progen_tpu.telemetry import spans
 
-        monkeypatch.setenv("PROGEN_CHAOS", "t/span:fail@1")
+        # synthetic test-local site, deliberately outside KNOWN_TARGETS
+        monkeypatch.setenv("PROGEN_CHAOS", "t/span:fail@1")  # progen: ignore[PGL009]
         chaos.install_from_env()
         try:
             assert chaos.maybe_inject in spans.SPAN_ENTRY_HOOKS
@@ -222,7 +223,8 @@ class TestChaosRules:
         assert chaos.install_from_env() is None
 
     def test_retry_absorbs_injected_transient_fault(self):
-        chaos.install("t/io:fail@1")
+        # synthetic test-local site, deliberately outside KNOWN_TARGETS
+        chaos.install("t/io:fail@1")  # progen: ignore[PGL009]
         try:
             out = retry.retry_call(
                 lambda: "fine", label="t/io", sleep=lambda s: None

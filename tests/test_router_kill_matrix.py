@@ -602,9 +602,11 @@ class TestRouterChaosTargets:
         chaos._WARNED_UNKNOWN.discard("router/bogus")
         try:
             with pytest.warns(UserWarning, match="router/bogus"):
-                chaos.install("router/bogus:fail@99")
+                # deliberately-unknown target: the warn-once under test
+                chaos.install("router/bogus:fail@99")  # progen: ignore[PGL009]
             with warnings.catch_warnings():
                 warnings.simplefilter("error")
-                chaos.install("router/bogus:fail@99")  # second: silent
+                # second install: silent (warn-once)
+                chaos.install("router/bogus:fail@99")  # progen: ignore[PGL009]
         finally:
             chaos.uninstall()

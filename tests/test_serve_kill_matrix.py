@@ -384,10 +384,12 @@ class TestChaosTargets:
         chaos._WARNED_UNKNOWN.discard("bogus/site")
         try:
             with pytest.warns(UserWarning, match="bogus/site"):
-                chaos.install("bogus/site:fail@99")
+                # deliberately-unknown target: the warn-once under test
+                chaos.install("bogus/site:fail@99")  # progen: ignore[PGL009]
             with warnings.catch_warnings():
                 warnings.simplefilter("error")
-                chaos.install("bogus/site:fail@99")  # second: silent
+                # second install: silent (warn-once)
+                chaos.install("bogus/site:fail@99")  # progen: ignore[PGL009]
         finally:
             chaos.uninstall()
 
